@@ -1,0 +1,51 @@
+"""gelly_tpu — TPU-native single-pass graph-stream analytics.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of
+``ZhouJiaLinmumu/gelly-streaming`` (Flink's experimental graph-streaming API):
+unbounded edge streams folded into compact mergeable summaries,
+partition-parallel across a TPU mesh with ICI collective merges.
+
+Layer map (mirrors SURVEY.md §1):
+  core/      EdgeChunk substrate, ingestion, EdgeStream API, windows
+  engine/    SummaryAggregation plugin contract + bulk/tree runners
+  ops/       device kernels: union-find, segment ops, hash set, triangles
+  parallel/  mesh, hash partitioning, collective merge primitives
+  library/   one-pass algorithms (CC, bipartiteness, spanner, triangles, ...)
+  utils/     metrics, native bindings, misc types
+"""
+
+import jax as _jax
+
+# The framework's id space is 64-bit (raw vertex ids, packed (src,dst) pair
+# keys). Without x64, jnp silently truncates int64 to int32, corrupting ids
+# > 2^31 and overflowing hash constants. Device compute paths stay i32/f32
+# (slots, values); only id plumbing is 64-bit. TPU supports s64 scatters.
+_jax.config.update("jax_enable_x64", True)
+
+from .core.chunk import EDGE_ADDITION, EDGE_DELETION, EdgeChunk, make_chunk
+from .core.io import TimeCharacteristic
+from .core.stream import (
+    EdgeStream,
+    StreamContext,
+    edge_stream_from_edges,
+    edge_stream_from_file,
+    edge_stream_from_source,
+)
+from .core.vertices import IdentityVertexTable, VertexTable
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "EDGE_ADDITION",
+    "EDGE_DELETION",
+    "EdgeChunk",
+    "EdgeStream",
+    "IdentityVertexTable",
+    "StreamContext",
+    "TimeCharacteristic",
+    "VertexTable",
+    "edge_stream_from_edges",
+    "edge_stream_from_file",
+    "edge_stream_from_source",
+    "make_chunk",
+]

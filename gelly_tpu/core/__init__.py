@@ -1,0 +1,3 @@
+from .chunk import EDGE_ADDITION, EDGE_DELETION, EdgeChunk, concat_chunks, empty_chunk, make_chunk
+from .io import EdgeChunkSource, TimeCharacteristic, chunks_from_edges, chunks_from_file, read_edge_list
+from .vertices import IdentityVertexTable, VertexTable

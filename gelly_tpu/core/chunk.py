@@ -1,0 +1,166 @@
+"""Fixed-capacity COO edge chunks — the unit of streaming on TPU.
+
+The reference (gelly-streaming) represents the stream as a Flink
+``DataStream<Edge<K,EV>>`` of one-record events (``M/SimpleEdgeStream.java:55-90``).
+A TPU cannot efficiently process one edge at a time: everything under ``jit`` is
+traced once over static shapes, and throughput comes from batched, masked array
+ops. So the atomic unit here is an :class:`EdgeChunk`: a fixed-capacity struct of
+arrays holding up to ``capacity`` edges, padded with an invalid mask. Every
+stream transform is a pure ``EdgeChunk -> EdgeChunk`` function, jittable and
+fuseable by XLA.
+
+Each edge carries two id representations:
+
+- ``raw_src`` / ``raw_dst``: the external 64-bit vertex ids, which user UDFs
+  (mapEdges / filterEdges / filterVertices predicates) observe — matching the
+  reference where UDFs see the original ``K`` ids.
+- ``src`` / ``dst``: dense ``i32`` slots assigned by a
+  :class:`~gelly_tpu.core.vertices.VertexTable` at ingest; all summary kernels
+  index fixed-shape state arrays with these. This replaces the reference's
+  hash-map keying of arbitrary ``K`` ids.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Event types, mirroring the reference's EventType enum
+# (/root/reference/src/main/java/org/apache/flink/graph/streaming/EventType.java:24-27).
+EDGE_ADDITION = np.int8(0)
+EDGE_DELETION = np.int8(1)
+
+
+class EdgeChunk(NamedTuple):
+    """A fixed-capacity batch of edges in structure-of-arrays COO layout.
+
+    Fields are always present so the pytree structure is static under jit:
+
+    - ``src``, ``dst``: ``i32[C]`` dense vertex slots (padding entries are 0).
+    - ``raw_src``, ``raw_dst``: ``i64[C]`` external vertex ids.
+    - ``val``: ``EV[C]`` or ``EV[C, k]`` edge values (default ``f32`` ones).
+    - ``ts``: ``i64[C]`` event-time or ingestion-time timestamps (ms).
+    - ``event``: ``i8[C]`` — 0 = addition, 1 = deletion (EventType equivalent).
+    - ``valid``: ``bool[C]`` — mask of live edges; everything else is padding.
+
+    The edge axis is axis 0 of every field.
+    """
+
+    src: jax.Array
+    dst: jax.Array
+    raw_src: jax.Array
+    raw_dst: jax.Array
+    val: jax.Array
+    ts: jax.Array
+    event: jax.Array
+    valid: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.src.shape[0]
+
+    def num_valid(self) -> jax.Array:
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def reverse(self) -> "EdgeChunk":
+        """Swap src/dst (GraphStream.reverse, M/SimpleEdgeStream.java:328-337)."""
+        return self._replace(
+            src=self.dst, dst=self.src, raw_src=self.raw_dst, raw_dst=self.raw_src
+        )
+
+    def undirected(self) -> "EdgeChunk":
+        """Emit each edge in both directions (M/SimpleEdgeStream.java:350-361).
+
+        Doubles the chunk capacity: the result holds ``e`` followed by
+        ``e.reverse()``.
+        """
+        return concat_chunks(self, self.reverse())
+
+    def mask(self, keep: jax.Array) -> "EdgeChunk":
+        """Return the chunk with ``valid &= keep`` (filter without moving data)."""
+        return self._replace(valid=self.valid & keep)
+
+    def to_numpy(self) -> "EdgeChunk":
+        return EdgeChunk(*(np.asarray(f) for f in self))
+
+    def compact_edges(self, raw: bool = True):
+        """Host-side: drop padding, return (src, dst, val) of the valid edges."""
+        c = self.to_numpy()
+        m = c.valid.astype(bool)
+        if raw:
+            return c.raw_src[m], c.raw_dst[m], c.val[m]
+        return c.src[m], c.dst[m], c.val[m]
+
+
+def make_chunk(
+    src,
+    dst,
+    raw_src=None,
+    raw_dst=None,
+    val=None,
+    ts=None,
+    event=None,
+    capacity: int | None = None,
+    val_dtype=jnp.float32,
+) -> EdgeChunk:
+    """Build a padded :class:`EdgeChunk` from host arrays.
+
+    ``capacity`` defaults to ``len(src)``; when larger, the tail is padding with
+    ``valid=False``. Padding slots use vertex 0 / value 0 and are never observed
+    by kernels, which must respect ``valid``. ``raw_src``/``raw_dst`` default to
+    the slot values (identity densification).
+    """
+    src = np.asarray(src, dtype=np.int32)
+    dst = np.asarray(dst, dtype=np.int32)
+    n = src.shape[0]
+    if dst.shape[0] != n:
+        raise ValueError(f"src/dst length mismatch: {n} vs {dst.shape[0]}")
+    cap = capacity if capacity is not None else n
+    if cap < n:
+        raise ValueError(f"capacity {cap} < number of edges {n}")
+
+    def pad(a, dtype):
+        a = np.asarray(a, dtype=dtype)
+        out = np.zeros((cap,) + a.shape[1:], dtype=dtype)
+        out[:n] = a
+        return out
+
+    raw_src = src if raw_src is None else raw_src
+    raw_dst = dst if raw_dst is None else raw_dst
+    if val is None:
+        val = np.ones((n,), dtype=np.dtype(val_dtype))
+    ts = np.arange(n, dtype=np.int64) if ts is None else ts
+    event = np.zeros((n,), dtype=np.int8) if event is None else event
+    valid = np.zeros((cap,), dtype=bool)
+    valid[:n] = True
+    return EdgeChunk(
+        src=jnp.asarray(pad(src, np.int32)),
+        dst=jnp.asarray(pad(dst, np.int32)),
+        raw_src=jnp.asarray(pad(raw_src, np.int64)),
+        raw_dst=jnp.asarray(pad(raw_dst, np.int64)),
+        val=jnp.asarray(pad(val, np.dtype(val_dtype))),
+        ts=jnp.asarray(pad(ts, np.int64)),
+        event=jnp.asarray(pad(event, np.int8)),
+        valid=jnp.asarray(valid),
+    )
+
+
+def empty_chunk(capacity: int, val_dtype=jnp.float32, val_shape=()) -> EdgeChunk:
+    return EdgeChunk(
+        src=jnp.zeros((capacity,), jnp.int32),
+        dst=jnp.zeros((capacity,), jnp.int32),
+        raw_src=jnp.zeros((capacity,), jnp.int64),
+        raw_dst=jnp.zeros((capacity,), jnp.int64),
+        val=jnp.zeros((capacity,) + val_shape, val_dtype),
+        ts=jnp.zeros((capacity,), jnp.int64),
+        event=jnp.zeros((capacity,), jnp.int8),
+        valid=jnp.zeros((capacity,), bool),
+    )
+
+
+def concat_chunks(a: EdgeChunk, b: EdgeChunk) -> EdgeChunk:
+    """Concatenate along the edge axis (capacity = a.capacity + b.capacity)."""
+    return EdgeChunk(*(jnp.concatenate([x, y], axis=0) for x, y in zip(a, b)))

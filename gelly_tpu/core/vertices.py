@@ -1,0 +1,108 @@
+"""Host-side vertex-id densification.
+
+The reference keys state by arbitrary ``K`` ids in per-subtask hash maps
+(e.g. ``DegreeMapFunction``'s ``HashMap<K, Long>``,
+``M/SimpleEdgeStream.java:461-478``, and ``DisjointSet``'s ``HashMap<R,R>``,
+``M/summaries/DisjointSet.java:28-29``). On TPU, summaries are fixed-shape
+arrays indexed by a dense ``i32`` slot, so raw ids are translated once at
+ingest on the host and never appear on device.
+
+Two tables:
+
+- :class:`VertexTable` — growable dict-based raw→slot mapping for arbitrary
+  (sparse / 64-bit / hashed) id spaces.
+- :class:`IdentityVertexTable` — zero-cost pass-through when ids are already
+  dense integers in ``[0, capacity)`` (the fast path for benchmark graphs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class VertexTable:
+    """Growable raw-id → dense-slot dictionary (host side).
+
+    ``capacity`` (when set, e.g. by the stream context binding this table)
+    bounds the slot space; encoding more distinct ids than that raises instead
+    of silently corrupting device summaries sized to the capacity.
+    """
+
+    def __init__(self, capacity: int | None = None):
+        self._map: dict[int, int] = {}
+        self._rev: list[int] = []
+        self.capacity = capacity
+
+    def __len__(self) -> int:
+        return len(self._rev)
+
+    @property
+    def num_vertices(self) -> int:
+        return len(self._rev)
+
+    def encode(self, raw_ids: np.ndarray) -> np.ndarray:
+        """Map raw ids to dense slots, assigning new slots for unseen ids."""
+        raw_ids = np.asarray(raw_ids).ravel()
+        out = np.empty(raw_ids.shape[0], dtype=np.int32)
+        m = self._map
+        rev = self._rev
+        cap = self.capacity
+        for i, r in enumerate(raw_ids.tolist()):
+            s = m.get(r)
+            if s is None:
+                s = len(rev)
+                if cap is not None and s >= cap:
+                    raise ValueError(
+                        f"vertex table overflow: more than {cap} distinct "
+                        f"vertex ids in the stream (raise vertex_capacity)"
+                    )
+                m[r] = s
+                rev.append(r)
+            out[i] = s
+        return out
+
+    def lookup(self, raw_ids: np.ndarray) -> np.ndarray:
+        """Map raw ids to slots; unseen ids map to -1."""
+        raw_ids = np.asarray(raw_ids).ravel()
+        m = self._map
+        return np.fromiter(
+            (m.get(r, -1) for r in raw_ids.tolist()), dtype=np.int32,
+            count=raw_ids.shape[0],
+        )
+
+    def decode(self, slots: np.ndarray) -> np.ndarray:
+        """Map dense slots back to raw ids."""
+        rev = np.asarray(self._rev, dtype=np.int64)
+        return rev[np.asarray(slots)]
+
+
+class IdentityVertexTable:
+    """Pass-through table for ids already dense in ``[0, capacity)``."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._max_seen = -1
+
+    def __len__(self) -> int:
+        return self._max_seen + 1
+
+    @property
+    def num_vertices(self) -> int:
+        return self._max_seen + 1
+
+    def encode(self, raw_ids: np.ndarray) -> np.ndarray:
+        raw_ids = np.asarray(raw_ids).ravel()
+        if raw_ids.size:
+            hi = int(raw_ids.max())
+            if hi >= self.capacity:
+                raise ValueError(
+                    f"vertex id {hi} out of range for capacity {self.capacity}"
+                )
+            self._max_seen = max(self._max_seen, hi)
+        return raw_ids.astype(np.int32)
+
+    def lookup(self, raw_ids: np.ndarray) -> np.ndarray:
+        return np.asarray(raw_ids).ravel().astype(np.int32)
+
+    def decode(self, slots: np.ndarray) -> np.ndarray:
+        return np.asarray(slots).astype(np.int64)

@@ -1,0 +1,2 @@
+from . import segments, unionfind
+from .hashset import DeviceHashSet

@@ -1,0 +1,131 @@
+"""Device-resident open-addressing hash set over i64 keys.
+
+Replaces the reference's unbounded per-key ``HashSet`` state
+(``DistinctEdgeMapper``, ``M/SimpleEdgeStream.java:309-323``) with a
+fixed-capacity, linear-probing table living in HBM. Membership-insert over a
+chunk is a ``lax.scan`` of O(1) probe loops per entry — sequential within the
+chunk (insertion order matters for exact first-wins semantics) but entirely
+on-device, so the stream never round-trips to the host.
+
+The table must be sized ahead (``capacity`` slots, power of two, keep load
+factor < 0.7); the host wrapper grows it by rehash when needed.
+
+Key contract: any i64 value except ``EMPTY`` (int64 min), which is the
+reserved unoccupied-slot sentinel. In-repo callers pack non-negative
+(src, dst) slot pairs, far from the sentinel.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EMPTY = jnp.int64(np.iinfo(np.int64).min)
+
+
+class HashSetState(NamedTuple):
+    keys: jax.Array  # i64[capacity], EMPTY where unoccupied
+    count: jax.Array  # i32[] number of occupied slots
+
+
+def make_hashset(capacity: int) -> HashSetState:
+    if capacity & (capacity - 1):
+        raise ValueError("capacity must be a power of two")
+    return HashSetState(
+        keys=jnp.full((capacity,), EMPTY, jnp.int64),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def _hash(key: jax.Array, mask: jax.Array) -> jax.Array:
+    # Fibonacci hashing on the low 64 bits.
+    h = (key * jnp.int64(-7046029254386353131)) >> jnp.int64(32)
+    return (h & mask.astype(jnp.int64)).astype(jnp.int32)
+
+
+def insert_chunk(state: HashSetState, keys: jax.Array, valid: jax.Array):
+    """Insert ``keys[valid]`` in order; returns (state, is_new bool mask).
+
+    ``is_new[i]`` is True iff ``keys[i]`` was not present before position ``i``
+    (counting both prior chunks and earlier entries of this chunk) — exact
+    streaming-distinct semantics.
+    """
+    cap = state.keys.shape[0]
+    mask = jnp.int32(cap - 1)
+
+    def insert_one(carry, inp):
+        table, count = carry
+        key, ok = inp
+
+        def probe_cond(h):
+            k = table[h]
+            return (k != EMPTY) & (k != key)
+
+        def probe_body(h):
+            return (h + 1) & mask
+
+        h0 = _hash(key, mask)
+        h = jax.lax.while_loop(probe_cond, probe_body, h0)
+        is_new = ok & (table[h] == EMPTY)
+        table = jnp.where(
+            is_new, table.at[h].set(key), table
+        )
+        count = count + is_new.astype(jnp.int32)
+        return (table, count), is_new
+
+    (table, count), is_new = jax.lax.scan(
+        insert_one, (state.keys, state.count), (keys.astype(jnp.int64), valid)
+    )
+    return HashSetState(table, count), is_new
+
+
+def contains_chunk(state: HashSetState, keys: jax.Array) -> jax.Array:
+    """Vectorized membership test (no insertion): bool[len(keys)]."""
+    cap = state.keys.shape[0]
+    mask = jnp.int32(cap - 1)
+    table = state.keys
+
+    def check_one(key):
+        def cond(carry):
+            h, _found, done = carry
+            return ~done
+
+        def body(carry):
+            h, found, _ = carry
+            k = table[h]
+            hit = k == key
+            done = hit | (k == EMPTY)
+            return ((h + 1) & mask, found | hit, done)
+
+        _, found, _ = jax.lax.while_loop(
+            cond, body, (_hash(key, mask), jnp.bool_(False), jnp.bool_(False))
+        )
+        return found
+
+    return jax.vmap(check_one)(keys.astype(jnp.int64))
+
+
+class DeviceHashSet:
+    """Host wrapper: auto-growing device hash set (rehash on high load)."""
+
+    def __init__(self, capacity: int = 1 << 16, max_load: float = 0.65):
+        self.state = make_hashset(capacity)
+        self.max_load = max_load
+        self._insert = jax.jit(insert_chunk)
+
+    def insert(self, keys: jax.Array, valid: jax.Array) -> jax.Array:
+        cap = self.state.keys.shape[0]
+        # Grow before inserting if the chunk could push past the load factor.
+        pending = int(self.state.count) + int(keys.shape[0])
+        while pending > self.max_load * cap:
+            cap *= 2
+            old = self.state.keys
+            occupied = old != EMPTY
+            fresh = make_hashset(cap)
+            fresh, _ = insert_chunk(fresh, old, occupied)
+            self.state = fresh
+        self.state, is_new = self._insert(self.state, keys, valid)
+        return is_new

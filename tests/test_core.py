@@ -1,0 +1,197 @@
+"""Core substrate tests: chunks, ingestion, vertex tables, stream transforms.
+
+Coverage model: the reference's operation tests
+(T/test/operations/TestGraphStreamCreation.java, TestMapEdges, TestFilterEdges,
+TestDistinct, TestGetDegrees, TestNumberOfEntities — SURVEY.md §4 tier 2),
+asserted on the canonical 5-vertex/7-edge fixture.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_tpu import (
+    EdgeChunk,
+    TimeCharacteristic,
+    VertexTable,
+    edge_stream_from_edges,
+    make_chunk,
+)
+from gelly_tpu.core.io import parse_edge_list_text
+
+
+def stream_of(edges, **kw):
+    kw.setdefault("vertex_capacity", 64)
+    kw.setdefault("chunk_size", 4)
+    return edge_stream_from_edges(edges, **kw)
+
+
+def test_chunk_padding_and_masks():
+    c = make_chunk([1, 2], [3, 4], capacity=8)
+    assert c.capacity == 8
+    assert int(c.num_valid()) == 2
+    r = c.reverse()
+    assert np.asarray(r.src)[:2].tolist() == [3, 4]
+    u = c.undirected()
+    assert u.capacity == 16
+    assert int(u.num_valid()) == 4
+
+
+def test_vertex_table_densifies_sparse_ids():
+    t = VertexTable()
+    slots = t.encode(np.array([100, 7, 100, 9**10]))
+    assert slots.tolist() == [0, 1, 0, 2]
+    assert t.decode(np.array([2, 0])).tolist() == [9**10, 100]
+    assert t.lookup(np.array([7, 12345])).tolist() == [1, -1]
+
+
+def test_parse_edge_list_with_comments():
+    src, dst, val = parse_edge_list_text(
+        "% a comment\n1 2\n# another\n3 4\n\n5 6\n"
+    )
+    assert src.tolist() == [1, 3, 5]
+    assert dst.tolist() == [2, 4, 6]
+    assert val is None
+    src, dst, val = parse_edge_list_text("1,2,0.5\n3,4,1.5", delimiter=",",
+                                         num_value_cols=1)
+    assert val.tolist() == [0.5, 1.5]
+
+
+def test_stream_roundtrip_preserves_edges(reference_edges):
+    got = stream_of(reference_edges).collect_edges()
+    assert sorted(got) == sorted(reference_edges)
+
+
+def test_ingestion_vs_event_time(reference_edges):
+    s = stream_of(reference_edges)
+    ts = np.concatenate([np.asarray(c.ts)[np.asarray(c.valid)] for c in s])
+    assert ts.tolist() == list(range(7))  # arrival index
+    s2 = stream_of(
+        reference_edges,
+        time=TimeCharacteristic.EVENT,
+        ts_fn=lambda s_, d_, v: v.astype(np.int64),
+    )
+    ts2 = np.concatenate([np.asarray(c.ts)[np.asarray(c.valid)] for c in s2])
+    assert ts2.tolist() == [12, 13, 23, 34, 35, 45, 51]
+
+
+def test_map_edges(reference_edges):
+    # TestMapEdges: add one to edge values.
+    got = stream_of(reference_edges).map_edges(lambda s, d, v: v + 1).collect_edges()
+    assert sorted(v for _, _, v in got) == [13.0, 14.0, 24.0, 35.0, 36.0, 46.0, 52.0]
+
+
+def test_filter_edges(reference_edges):
+    got = stream_of(reference_edges).filter_edges(
+        lambda s, d, v: v > 30
+    ).collect_edges()
+    assert sorted(got) == [(3, 4, 34.0), (3, 5, 35.0), (4, 5, 45.0), (5, 1, 51.0)]
+
+
+def test_filter_vertices_keeps_edge_iff_both_pass(reference_edges):
+    # ApplyVertexFilterToEdges: both endpoints must pass.
+    got = stream_of(reference_edges).filter_vertices(lambda v: v > 2).collect_edges()
+    assert sorted(got) == [(3, 4, 34.0), (3, 5, 35.0), (4, 5, 45.0)]
+
+
+def test_reverse_undirected(reference_edges):
+    rev = stream_of(reference_edges).reverse().collect_edges()
+    assert sorted((s, d) for s, d, _ in rev) == sorted(
+        (d, s) for s, d, _ in reference_edges
+    )
+    und = stream_of(reference_edges).undirected().collect_edges()
+    assert len(und) == 14
+
+
+def test_union(reference_edges):
+    s1 = stream_of(reference_edges[:3])
+    from gelly_tpu.core.io import chunks_from_edges
+    from gelly_tpu.core.stream import EdgeStream
+
+    # Second stream must share the context/table.
+    src2 = chunks_from_edges(reference_edges[3:], chunk_size=4,
+                             table=s1.ctx.table)
+    s2 = EdgeStream(lambda: iter(src2), s1.ctx)
+    got = s1.union(s2).collect_edges()
+    assert sorted(got) == sorted(reference_edges)
+
+
+def test_distinct():
+    # TestDistinct: duplicated input collapses to unique (src, dst) pairs.
+    edges = [(1, 2, 1.0), (1, 2, 1.0), (2, 3, 1.0), (1, 2, 9.0), (3, 4, 1.0),
+             (2, 3, 5.0)]
+    got = stream_of(edges, chunk_size=2).distinct().collect_edges()
+    assert sorted((s, d) for s, d, _ in got) == [(1, 2), (2, 3), (3, 4)]
+    # first-wins: the surviving (1,2) is the first one (val 1.0)
+    vals = {(s, d): v for s, d, v in got}
+    assert vals[(1, 2)] == 1.0
+
+
+def test_get_vertices(reference_edges):
+    s = stream_of(reference_edges)
+    seen = []
+    for upd in s.get_vertices():
+        seen.extend(i for i, _ in upd.to_pairs(s.ctx))
+    assert sorted(seen) == [1, 2, 3, 4, 5]
+    assert len(seen) == 5  # no duplicates across chunks
+
+
+def test_degrees(reference_edges):
+    # TestGetDegrees final values.
+    s = stream_of(reference_edges, chunk_size=3)
+    assert s.get_degrees().final_degrees() == {1: 3, 2: 2, 3: 4, 4: 2, 5: 3}
+    s = stream_of(reference_edges, chunk_size=3)
+    assert s.get_out_degrees().final_degrees() == {1: 2, 2: 1, 3: 2, 4: 1, 5: 1}
+    s = stream_of(reference_edges, chunk_size=3)
+    assert s.get_in_degrees().final_degrees() == {1: 1, 2: 1, 3: 2, 4: 1, 5: 2}
+
+
+def test_degrees_continuously_improving(reference_edges):
+    # The degree stream re-emits updated values as edges arrive
+    # (DegreeMapFunction semantics at chunk granularity).
+    s = stream_of(reference_edges, chunk_size=1)
+    updates = [dict(u.to_pairs(s.ctx)) for u in s.get_degrees()]
+    assert updates[0] == {1: 1, 2: 1}          # after (1,2)
+    assert updates[1] == {1: 2, 3: 1}          # after (1,3)
+    assert updates[-1][1] == 3 and updates[-1][5] == 3  # after (5,1)
+
+
+def test_counts(reference_edges):
+    s = stream_of(reference_edges, chunk_size=2)
+    assert list(s.number_of_edges())[-1] == 7
+    s = stream_of(reference_edges, chunk_size=2)
+    counts = list(s.number_of_vertices())
+    assert counts[-1] == 5
+    assert counts == sorted(counts)  # monotone, emit-on-change
+
+
+def test_deletion_events_decrement_degrees():
+    from gelly_tpu.core.io import EdgeChunkSource
+    from gelly_tpu.core.stream import edge_stream_from_source
+
+    def make():
+        src = EdgeChunkSource(
+            np.array([1, 1, 1]), np.array([2, 3, 2]),
+            events=np.array([0, 0, 1], np.int8), chunk_size=2,
+        )
+        return edge_stream_from_source(src, vertex_capacity=16)
+
+    assert make().get_degrees().final_degrees() == {1: 1, 2: 0, 3: 1}
+    # numberOfEdges tracks the live graph: 2 adds - 1 delete = 1.
+    assert list(make().number_of_edges())[-1] == 1
+
+
+def test_vertex_capacity_overflow_raises(reference_edges):
+    s = stream_of(reference_edges, vertex_capacity=3)
+    with pytest.raises(ValueError, match="overflow|capacity"):
+        s.collect_edges()
+
+
+def test_get_vertices_emits_raw_ids():
+    big = 5_000_000_000
+    s = stream_of([(big, 7, 1.0)])
+    upds = list(s.get_vertices())
+    ids = [i for u in upds for i, _ in u.to_pairs(s.ctx)]
+    assert sorted(ids) == [7, big]
+    # values carry the raw id too, not internal slots
+    vals = [int(v) for u in upds for _, v in u.to_pairs(s.ctx)]
+    assert sorted(vals) == [7, big]
