@@ -1,0 +1,7 @@
+from .aggregation import (
+    SummaryAggregation,
+    SummaryStream,
+    edges_fold_adapter,
+    run_aggregation,
+)
+from .checkpoint import load_checkpoint, save_checkpoint

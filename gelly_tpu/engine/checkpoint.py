@@ -1,0 +1,72 @@
+"""Checkpoint / resume of summary state.
+
+The reference's only checkpoint hook is ``Merger implements ListCheckpointed``:
+``snapshotState`` returns ``[summary]`` and ``restoreState`` reads it back
+(``M/SummaryAggregation.java:127-135``) — the summary *is* the checkpoint
+payload. Same stance here: a checkpoint is the device→host snapshot of the
+global summary pytree plus the stream position (chunks consumed), written
+atomically; resume reloads the arrays and continues folding from that
+position.
+
+Format: ``.npz`` with flattened leaves + a JSON header describing the pytree
+structure — no pickle, so checkpoints are portable and inspectable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def save_checkpoint(path: str, summary, position: int = 0,
+                    meta: dict | None = None) -> None:
+    """Atomically write ``summary`` (any pytree of arrays) + stream position."""
+    leaves, treedef = jax.tree.flatten(summary)
+    header = {
+        "treedef": str(treedef),
+        "num_leaves": len(leaves),
+        "position": int(position),
+        "meta": meta or {},
+    }
+    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __header__=np.frombuffer(
+                json.dumps(header).encode(), dtype=np.uint8
+            ), **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path: str, like=None):
+    """Load a checkpoint. Returns ``(summary, position, meta)``.
+
+    ``like`` — a template pytree with the same structure (e.g. ``agg.init()``);
+    required to rebuild structured summaries. When None, returns the flat leaf
+    list in saved order.
+    """
+    with np.load(path) as z:
+        header = json.loads(bytes(z["__header__"]).decode())
+        leaves = [z[f"leaf_{i}"] for i in range(header["num_leaves"])]
+    if like is not None:
+        _, treedef = jax.tree.flatten(like)
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"checkpoint has {len(leaves)} leaves; template has "
+                f"{treedef.num_leaves}"
+            )
+        summary = jax.tree.unflatten(treedef, leaves)
+    else:
+        summary = leaves
+    return summary, header["position"], header["meta"]

@@ -1,0 +1,6 @@
+from .connected_components import (
+    CCSummary,
+    connected_components,
+    connected_components_tree,
+    labels_to_components,
+)

@@ -1,0 +1,16 @@
+from .collectives import butterfly_merge, gather_merge, psum_tree
+from .mesh import (
+    SHARD_AXIS,
+    make_mesh,
+    num_shards,
+    replicated_spec,
+    shard_map_fn,
+    shard_spec,
+)
+from .partition import (
+    owned_mask,
+    owner_of,
+    slots_per_shard,
+    split_chunk,
+    to_local_slot,
+)

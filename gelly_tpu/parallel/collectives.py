@@ -1,0 +1,76 @@
+"""Collective merge primitives: the TPU replacement for Flink's shuffle fan-in.
+
+The reference merges per-partition summaries two ways:
+
+- flat: ``timeWindowAll().reduce(combine)`` — all partials fan in to one
+  parallelism-1 task (``M/SummaryBulkAggregation.java:81-83``);
+- tree: recursive ``enhance()`` halving parallelism each level
+  (``M/SummaryTreeReduce.java:95-123``), a log-depth reduction tree over
+  network shuffles.
+
+On TPU both become ICI collectives inside ``shard_map``:
+
+- :func:`butterfly_merge` — a log₂(S)-step recursive-doubling exchange with a
+  user ``combine(a, b)`` over arbitrary summary pytrees. After step k every
+  device holds the merge of its 2^(k+1)-device group; at the end **all**
+  devices hold the global summary (an allreduce with a custom monoid). This is
+  the merge-tree mapped onto the ICI topology.
+- :func:`gather_merge` — ``all_gather`` the K per-device summaries and fold
+  them on every device; right choice when the combine is cheaper over the
+  stacked representation (e.g. union-find's K×N edge interpretation).
+
+Both require the shard count to be a power of two (TPU slices are).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import SHARD_AXIS
+
+
+def _ppermute_tree(tree, perm, axis_name):
+    return jax.tree.map(
+        lambda x: jax.lax.ppermute(x, axis_name, perm), tree
+    )
+
+
+def butterfly_merge(combine: Callable, summary, num_shards: int,
+                    axis_name: str = SHARD_AXIS):
+    """Recursive-doubling allreduce with a custom combine monoid.
+
+    Must be called inside ``shard_map`` over ``axis_name``. ``combine(a, b)``
+    must be a jax-traceable, associative+commutative merge of two summaries.
+    """
+    if num_shards & (num_shards - 1):
+        raise ValueError("butterfly_merge requires power-of-two shards")
+    step = 1
+    while step < num_shards:
+        # XOR-partner exchange: i <-> i ^ step.
+        perm = [(i, i ^ step) for i in range(num_shards)]
+        other = _ppermute_tree(summary, perm, axis_name)
+        summary = combine(summary, other)
+        step <<= 1
+    return summary
+
+
+def gather_merge(merge_stacked: Callable, summary, axis_name: str = SHARD_AXIS):
+    """all_gather all shards' summaries and fold with ``merge_stacked``.
+
+    ``merge_stacked(stacked)`` receives each leaf with a new leading axis of
+    size num_shards and must return the merged summary. Every device computes
+    the same global result (replicated output).
+    """
+    stacked = jax.tree.map(
+        lambda x: jax.lax.all_gather(x, axis_name, axis=0), summary
+    )
+    return merge_stacked(stacked)
+
+
+def psum_tree(tree, axis_name: str = SHARD_AXIS):
+    """Elementwise-additive merge (degree histograms, counters)."""
+    return jax.lax.psum(tree, axis_name)

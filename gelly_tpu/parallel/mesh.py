@@ -1,0 +1,66 @@
+"""Device mesh helpers — the substrate the reference delegated to Flink.
+
+The reference's notion of parallelism is Flink operator subtasks connected by
+Netty shuffles (SURVEY.md §2.8-2.9); here the equivalent substrate is a
+``jax.sharding.Mesh`` over the TPU slice, with ``shard_map`` partitioning and
+XLA collectives over ICI. A single 1-D ``shards`` axis plays the role of
+operator parallelism; multi-host meshes extend the same axis over DCN.
+
+For tests (the MiniCluster analog) the CPU backend is forced with
+``--xla_force_host_platform_device_count=8``; the same code paths then run on
+real chips unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(num_shards: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over ``num_shards`` devices (default: all available)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if num_shards is not None:
+        if num_shards > len(devs):
+            raise ValueError(
+                f"requested {num_shards} shards but only {len(devs)} devices"
+            )
+        devs = devs[:num_shards]
+    return Mesh(np.array(devs), (SHARD_AXIS,))
+
+
+def num_shards(mesh: Mesh) -> int:
+    return mesh.shape[SHARD_AXIS]
+
+
+def shard_spec() -> P:
+    """Partition along the shard axis (leading dim)."""
+    return P(SHARD_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_map_fn(mesh: Mesh, fn, in_specs, out_specs, check_vma: bool = False):
+    """Thin wrapper over jax.shard_map pinned to the stream mesh."""
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=check_vma,
+    )
+
+
+def device_put_sharded_leading(mesh: Mesh, tree):
+    """Place a pytree whose leaves have leading dim == num_shards, sharded."""
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    return jax.device_put(tree, sharding)
+
+
+def device_put_replicated(mesh: Mesh, tree):
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)

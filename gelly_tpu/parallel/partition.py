@@ -1,0 +1,74 @@
+"""Edge partitioning across the mesh — the ``keyBy`` / ``PartitionMapper`` analog.
+
+Two modes mirror the reference's two shuffle patterns (SURVEY.md §2.8):
+
+1. **Edge data-parallel** (:func:`split_chunk`): the chunk is sliced evenly
+   across shards, each device folding its slice into a full-vertex-space local
+   summary — the reference's subtask-index partitioning
+   (``SummaryBulkAggregation.PartitionMapper``, ``:93-106``). No communication;
+   the merge happens later via collectives.
+
+2. **Vertex-hash partition** (:func:`owned_mask` inside ``shard_map``): state
+   is range-partitioned over vertex slots, and each device processes only the
+   edges whose group vertex it owns — the ``keyBy(0)`` shuffle. Realized as
+   broadcast-then-mask: the (small) chunk is visible to all devices and each
+   masks to its owned keys, trading a little redundant decode for zero ragged
+   all_to_all plumbing. The contiguous range partition keeps each device's
+   vertex state a dense slice (slot // slots_per_shard == shard).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.chunk import EdgeChunk
+from .mesh import SHARD_AXIS
+
+
+def split_chunk(chunk: EdgeChunk, num_shards: int) -> EdgeChunk:
+    """Reshape a chunk [C] into per-shard slices [S, ⌈C/S⌉] (data parallelism).
+
+    Chunks smaller than (or not divisible by) the shard count are padded with
+    invalid entries first. Leading axis is the shard axis, to be consumed with
+    in_specs=P('shards').
+    """
+    c = chunk.capacity
+    per = -(-c // num_shards)  # ceil
+    padded = per * num_shards
+    if padded != c:
+        pad = padded - c
+
+        def pad_leaf(x):
+            widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths)
+
+        chunk = EdgeChunk(*(pad_leaf(x) for x in chunk))
+    return EdgeChunk(
+        *(x.reshape((num_shards, per) + x.shape[1:]) for x in chunk)
+    )
+
+
+def slots_per_shard(vertex_capacity: int, num_shards: int) -> int:
+    if vertex_capacity % num_shards:
+        raise ValueError(
+            f"vertex_capacity {vertex_capacity} not divisible by {num_shards}"
+        )
+    return vertex_capacity // num_shards
+
+
+def owner_of(slots: jax.Array, per_shard: int) -> jax.Array:
+    """Shard index owning each vertex slot (contiguous range partition)."""
+    return slots // per_shard
+
+
+def owned_mask(slots: jax.Array, per_shard: int,
+               axis_name: str = SHARD_AXIS) -> jax.Array:
+    """Inside shard_map: mask of entries whose key this device owns."""
+    me = jax.lax.axis_index(axis_name)
+    return owner_of(slots, per_shard) == me
+
+
+def to_local_slot(slots: jax.Array, per_shard: int) -> jax.Array:
+    """Global slot -> offset within the owning device's state slice."""
+    return slots % per_shard

@@ -8,7 +8,6 @@ collective paths run multi-device without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,6 +17,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
+# The environment boots a single-chip TPU platform at interpreter start and
+# pins jax_platforms to it; the config update (post-import, pre-device-init)
+# wins and forces the 8-virtual-device CPU backend for the test mesh.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

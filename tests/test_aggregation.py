@@ -1,0 +1,181 @@
+"""Engine + streaming CC end-to-end: the minimum end-to-end slice.
+
+Parity oracle: the reference's ConnectedComponentsTest
+(T/example/test/ConnectedComponentsTest.java:54-63) — edges
+(1,2),(1,3),(2,3),(1,5),(6,7),(8,9) → components {1,2,3,5},{6,7},{8,9}.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gelly_tpu import edge_stream_from_edges
+from gelly_tpu.engine.aggregation import (
+    SummaryAggregation,
+    edges_fold_adapter,
+)
+from gelly_tpu.library.connected_components import (
+    connected_components,
+    connected_components_tree,
+    labels_to_components,
+)
+
+CC_EDGES = [(1, 2), (1, 3), (2, 3), (1, 5), (6, 7), (8, 9)]
+CC_EXPECTED = [[1, 2, 3, 5], [6, 7], [8, 9]]
+
+
+def cc_stream(chunk_size=2, vertex_capacity=64):
+    return edge_stream_from_edges(
+        [(s, d, 1.0) for s, d in CC_EDGES],
+        vertex_capacity=vertex_capacity,
+        chunk_size=chunk_size,
+    )
+
+
+@pytest.mark.parametrize("merge", ["tree", "gather"])
+@pytest.mark.parametrize("chunk_size", [2, 8])
+def test_cc_parity_with_reference_fixture(merge, chunk_size):
+    s = cc_stream(chunk_size=chunk_size)
+    agg = connected_components(s.ctx.vertex_capacity, merge=merge)
+    labels = s.aggregate(agg).result()
+    assert labels_to_components(labels, s.ctx) == CC_EXPECTED
+
+
+def test_cc_tree_alias():
+    s = cc_stream()
+    agg = connected_components_tree(s.ctx.vertex_capacity)
+    labels = s.aggregate(agg).result()
+    assert labels_to_components(labels, s.ctx) == CC_EXPECTED
+
+
+def test_cc_emits_per_window_and_improves():
+    # merge_every=1: one emission per chunk; summaries accumulate
+    # (non-transient Merger, M/SummaryAggregation.java:107-119).
+    s = cc_stream(chunk_size=2)
+    agg = connected_components(s.ctx.vertex_capacity)
+    emissions = list(s.aggregate(agg, merge_every=1))
+    assert len(emissions) == 3  # 6 edges / chunk_size 2
+    # First window: only (1,2),(1,3) seen.
+    first = labels_to_components(emissions[0], s.ctx)
+    assert first == [[1, 2, 3]]
+    final = labels_to_components(emissions[-1], s.ctx)
+    assert final == CC_EXPECTED
+
+
+def test_cc_window_ms_time_windows():
+    # Event-time tumbling windows: edges timestamped 0..5, window of 2 →
+    # 3 windows, labels accumulate to the same final parity.
+    s = edge_stream_from_edges(
+        [(s_, d_, 1.0) for s_, d_ in CC_EDGES],
+        vertex_capacity=64,
+        chunk_size=3,
+    )
+    agg = connected_components(s.ctx.vertex_capacity)
+    emissions = list(s.aggregate(agg, window_ms=2))
+    assert labels_to_components(emissions[-1], s.ctx) == CC_EXPECTED
+    assert len(emissions) == 3
+
+
+def test_transient_aggregation_resets_per_window():
+    # A transient count-edges aggregation: per-window counts don't accumulate.
+    def init():
+        return jnp.zeros((), jnp.int32)
+
+    agg = SummaryAggregation(
+        init=init,
+        fold=lambda s, c: s + c.num_valid().astype(jnp.int32),
+        combine=lambda a, b: a + b,
+        transient=True,
+    )
+    s = cc_stream(chunk_size=2)
+    counts = [int(x) for x in s.aggregate(agg, merge_every=1)]
+    assert counts == [2, 2, 2]
+    # Non-transient accumulates.
+    agg2 = SummaryAggregation(
+        init=init,
+        fold=lambda s, c: s + c.num_valid().astype(jnp.int32),
+        combine=lambda a, b: a + b,
+        transient=False,
+    )
+    s = cc_stream(chunk_size=2)
+    counts = [int(x) for x in s.aggregate(agg2, merge_every=1)]
+    assert counts == [2, 4, 6]
+
+
+def count_agg():
+    return SummaryAggregation(
+        init=lambda: jnp.zeros((), jnp.int64),
+        fold=lambda s, c: s + c.num_valid().astype(jnp.int64),
+        combine=lambda a, b: a + b,
+    )
+
+
+def test_window_gaps_do_not_fire_empty_windows():
+    # Timestamps jump 0 -> 1000: no per-empty-window emissions, just 2.
+    from gelly_tpu import TimeCharacteristic
+    s = edge_stream_from_edges(
+        [(1, 2, 1.0), (3, 4, 1.0)], vertex_capacity=16, chunk_size=2,
+        time=TimeCharacteristic.EVENT, timestamps=np.array([0, 1000]),
+    )
+    emissions = list(s.aggregate(count_agg(), window_ms=1))
+    assert [int(e) for e in emissions] == [1, 2]
+
+
+def test_late_edges_counted_and_dropped():
+    from gelly_tpu import TimeCharacteristic
+    # Second chunk carries an edge for an already-closed window (ts=0 after
+    # window 5 opened): dropped, counted in stats.
+    s = edge_stream_from_edges(
+        [(1, 2, 1.0), (3, 4, 1.0), (5, 6, 1.0), (7, 8, 1.0)],
+        vertex_capacity=16, chunk_size=2,
+        time=TimeCharacteristic.EVENT,
+        timestamps=np.array([10, 11, 0, 13]),
+    )
+    ss = s.aggregate(count_agg(), window_ms=2)
+    emissions = [int(e) for e in ss]
+    assert ss.stats["late_edges"] == 1
+    assert emissions[-1] == 3  # late edge never counted
+
+
+def test_checkpoint_midwindow_chunk_boundary_resume(tmp_path):
+    # A chunk spanning two windows: checkpoint at the chunk boundary must
+    # capture the open window's edges (in locals) so resume loses nothing
+    # and double-counts nothing.
+    from gelly_tpu import TimeCharacteristic
+
+    p = str(tmp_path / "w.npz")
+    edges = [(1, 2, 1.0), (3, 4, 1.0), (5, 6, 1.0), (7, 8, 1.0)]
+    ts = np.array([0, 1, 2, 3])
+
+    def make(k):
+        return edge_stream_from_edges(
+            edges[:k], vertex_capacity=16, chunk_size=2,
+            time=TimeCharacteristic.EVENT, timestamps=ts[:k],
+        )
+
+    # Run only the first chunk (ts 0,1 -> window 0 closed at ts=2? no:
+    # chunk1 = ts[0,1], both window 0, stays open) with checkpointing.
+    list(make(2).aggregate(count_agg(), window_ms=2, checkpoint_path=p))
+    # Resume over the full stream; final total must be exactly 4.
+    ss = make(4).aggregate(count_agg(), window_ms=2, checkpoint_path=p,
+                           resume=True)
+    emissions = [int(e) for e in ss]
+    assert emissions[-1] == 4
+
+
+def test_edges_fold_adapter_per_edge_udf():
+    # Per-edge EdgesFold parity: sum of edge values via sequential scan.
+    def fold_edges(acc, src, dst, val):
+        return acc + val
+
+    agg = SummaryAggregation(
+        init=lambda: jnp.zeros((), jnp.float32),
+        fold=edges_fold_adapter(fold_edges),
+        combine=lambda a, b: a + b,
+    )
+    s = edge_stream_from_edges(
+        [(1, 2, 1.5), (2, 3, 2.5), (3, 4, 3.0)], vertex_capacity=16,
+        chunk_size=2,
+    )
+    total = float(s.aggregate(agg).result())
+    assert total == pytest.approx(7.0)

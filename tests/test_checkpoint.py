@@ -1,0 +1,76 @@
+"""Checkpoint/resume: the summary IS the checkpoint payload (SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from gelly_tpu import edge_stream_from_edges
+from gelly_tpu.engine.checkpoint import load_checkpoint, save_checkpoint
+from gelly_tpu.library.connected_components import (
+    connected_components,
+    labels_to_components,
+)
+
+CC_EDGES = [(1, 2), (1, 3), (2, 3), (1, 5), (6, 7), (8, 9)]
+CC_EXPECTED = [[1, 2, 3, 5], [6, 7], [8, 9]]
+
+
+def test_save_load_roundtrip(tmp_path):
+    from gelly_tpu.library.connected_components import CCSummary
+
+    agg = connected_components(32)
+    s = agg.init()
+    p = str(tmp_path / "ck.npz")
+    save_checkpoint(p, s, position=7, meta={"k": "v"})
+    loaded, pos, meta = load_checkpoint(p, like=agg.init())
+    assert pos == 7 and meta == {"k": "v"}
+    assert isinstance(loaded, CCSummary)
+    np.testing.assert_array_equal(np.asarray(loaded.parent), np.asarray(s.parent))
+
+
+def test_resume_continues_cc(tmp_path):
+    p = str(tmp_path / "cc.npz")
+
+    def stream():
+        return edge_stream_from_edges(
+            [(a, b, 1.0) for a, b in CC_EDGES], vertex_capacity=64,
+            chunk_size=2,
+        )
+
+    s1 = stream()
+    agg = connected_components(64)
+    # Run the full stream once with per-window checkpoints.
+    final = s1.aggregate(agg, merge_every=1, checkpoint_path=p).result()
+    assert labels_to_components(final, s1.ctx) == CC_EXPECTED
+
+    # Resume from the checkpoint: all chunks already consumed -> the stored
+    # summary alone must reproduce the final labels.
+    s2 = stream()
+    resumed = s2.aggregate(
+        agg, merge_every=1, checkpoint_path=p, resume=True
+    ).result()
+    assert resumed is None  # nothing left to fold; no emission
+
+    _, pos, meta = load_checkpoint(p, like=agg.init())
+    assert pos == 3 and meta["windows"] == 3
+
+
+def test_resume_midstream_matches_full_run(tmp_path):
+    p = str(tmp_path / "cc_mid.npz")
+    agg = connected_components(64)
+
+    # First run: only the first 2 chunks (4 edges), checkpointing.
+    s1 = edge_stream_from_edges(
+        [(a, b, 1.0) for a, b in CC_EDGES[:4]], vertex_capacity=64,
+        chunk_size=2,
+    )
+    s1.aggregate(agg, merge_every=1, checkpoint_path=p).result()
+
+    # Resume over the full stream: chunks 1-2 skipped, chunk 3 folded.
+    s2 = edge_stream_from_edges(
+        [(a, b, 1.0) for a, b in CC_EDGES], vertex_capacity=64, chunk_size=2,
+        table=None,
+    )
+    final = s2.aggregate(
+        agg, merge_every=1, checkpoint_path=p, resume=True
+    ).result()
+    assert labels_to_components(final, s2.ctx) == CC_EXPECTED

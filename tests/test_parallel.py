@@ -1,0 +1,115 @@
+"""Parallel-layer tests on the 8-virtual-device CPU mesh (MiniCluster analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gelly_tpu import make_chunk
+from gelly_tpu.parallel import (
+    SHARD_AXIS,
+    butterfly_merge,
+    gather_merge,
+    make_mesh,
+    num_shards,
+    owned_mask,
+    psum_tree,
+    shard_map_fn,
+    split_chunk,
+    slots_per_shard,
+    to_local_slot,
+)
+
+
+def test_mesh_has_8_virtual_devices():
+    mesh = make_mesh()
+    assert num_shards(mesh) == 8
+
+
+def test_split_chunk_roundtrip():
+    c = make_chunk(np.arange(16), np.arange(16) + 100, capacity=16)
+    s = split_chunk(c, 4)
+    assert s.src.shape == (4, 4)
+    assert np.asarray(s.src).reshape(-1).tolist() == np.asarray(c.src).tolist()
+
+
+def test_butterfly_merge_equals_global_reduce():
+    mesh = make_mesh()
+    S = num_shards(mesh)
+    x = jnp.arange(S * 3, dtype=jnp.float32).reshape(S, 3)
+
+    def body(xs):
+        local = xs  # [3] per device
+        merged = butterfly_merge(jnp.maximum, local, S)
+        return merged
+
+    out = shard_map_fn(mesh, body, in_specs=P(SHARD_AXIS), out_specs=P(SHARD_AXIS))(x)
+    # every device must hold the global max
+    expect = np.asarray(x).max(axis=0)
+    for d in range(S):
+        np.testing.assert_array_equal(np.asarray(out)[d], expect)
+
+
+def test_butterfly_merge_noncommutative_size_merge():
+    # Merge monoid like the reference's CombineCC (smaller into larger):
+    # (count, payload_sum) where combine keeps the sum but max-counts;
+    # associativity over the butterfly must still yield the global result.
+    mesh = make_mesh()
+    S = num_shards(mesh)
+    counts = jnp.arange(S, dtype=jnp.int32).reshape(S, 1) + 1
+    sums = jnp.ones((S, 1), jnp.float32)
+
+    def combine(a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def body(c, s):
+        merged = butterfly_merge(combine, (c[0], s[0]), S)
+        return merged[0][None], merged[1][None]
+
+    c_out, s_out = shard_map_fn(
+        mesh, body, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )(counts, sums)
+    assert np.asarray(c_out)[0].item() == sum(range(1, S + 1))
+    assert np.asarray(s_out)[3].item() == S
+
+
+def test_gather_merge_stacks_all_shards():
+    mesh = make_mesh()
+    S = num_shards(mesh)
+    x = jnp.arange(S, dtype=jnp.int32).reshape(S, 1)
+
+    def body(xs):
+        return gather_merge(lambda st: jnp.sum(st, axis=0), xs)
+
+    out = shard_map_fn(mesh, body, in_specs=P(SHARD_AXIS), out_specs=P(SHARD_AXIS))(x)
+    assert np.asarray(out).reshape(S).tolist() == [sum(range(S))] * S
+
+
+def test_psum_tree():
+    mesh = make_mesh()
+    S = num_shards(mesh)
+    x = jnp.ones((S, 4), jnp.int32)
+
+    def body(xs):
+        return psum_tree(xs)
+
+    out = shard_map_fn(mesh, body, in_specs=P(SHARD_AXIS), out_specs=P(SHARD_AXIS))(x)
+    assert np.asarray(out)[0].tolist() == [S] * 4
+
+
+def test_vertex_range_partition_masks():
+    mesh = make_mesh()
+    S = num_shards(mesh)
+    cap = 64
+    per = slots_per_shard(cap, S)
+    slots = jnp.arange(cap, dtype=jnp.int32)
+
+    def body():
+        m = owned_mask(slots, per)
+        return jnp.sum(m.astype(jnp.int32))[None]
+
+    counts = shard_map_fn(mesh, body, in_specs=(), out_specs=P(SHARD_AXIS))()
+    assert np.asarray(counts).tolist() == [per] * S
+    assert int(to_local_slot(jnp.int32(per + 3), per)) == 3

@@ -1,0 +1,89 @@
+"""Union-find kernel unit tests — DisjointSetTest analog
+(T/util/DisjointSetTest.java:32-78)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gelly_tpu.ops.unionfind import (
+    component_labels,
+    fresh_forest,
+    merge_forest_stack,
+    merge_forests,
+    pointer_jump,
+    union_edges,
+)
+
+
+def labels_of(parent, n_used):
+    return np.asarray(pointer_jump(parent))[:n_used].tolist()
+
+
+def test_union_basic_chain():
+    p = fresh_forest(8)
+    src = jnp.array([0, 1, 2], jnp.int32)
+    dst = jnp.array([1, 2, 3], jnp.int32)
+    p = union_edges(p, src, dst, jnp.ones(3, bool))
+    assert labels_of(p, 4) == [0, 0, 0, 0]
+
+
+def test_union_respects_valid_mask():
+    p = fresh_forest(8)
+    src = jnp.array([0, 2], jnp.int32)
+    dst = jnp.array([1, 3], jnp.int32)
+    p = union_edges(p, src, dst, jnp.array([True, False]))
+    assert labels_of(p, 4) == [0, 0, 2, 3]
+
+
+def test_union_order_free_canonical():
+    # Same component set regardless of edge order; root is the min slot.
+    edges = [(4, 2), (2, 7), (7, 1), (5, 6)]
+    for perm in ([0, 1, 2, 3], [3, 2, 1, 0], [1, 3, 0, 2]):
+        p = fresh_forest(8)
+        src = jnp.array([edges[i][0] for i in perm], jnp.int32)
+        dst = jnp.array([edges[i][1] for i in perm], jnp.int32)
+        p = union_edges(p, src, dst, jnp.ones(4, bool))
+        lab = labels_of(p, 8)
+        assert lab[1] == lab[2] == lab[4] == lab[7] == 1
+        assert lab[5] == lab[6] == 5
+
+
+def test_merge_even_odd_forests():
+    # DisjointSetTest's merge scenario: an "evens" forest and an "odds"
+    # forest over 18 elements merge into 2 roots (:60-78).
+    n = 18
+    evens = fresh_forest(32)
+    odds = fresh_forest(32)
+    e = jnp.array(range(0, n - 2, 2), jnp.int32)
+    evens = union_edges(evens, e, e + 2, jnp.ones_like(e, dtype=bool))
+    o = jnp.array(range(1, n - 2, 2), jnp.int32)
+    odds = union_edges(odds, o, o + 2, jnp.ones_like(o, dtype=bool))
+    merged = merge_forests(evens, odds)
+    lab = labels_of(merged, n)
+    assert set(lab[0::2]) == {0}
+    assert set(lab[1::2]) == {1}
+    assert len(set(lab)) == 2
+
+
+def test_merge_stack_equals_pairwise():
+    n = 16
+    f1 = union_edges(fresh_forest(n), jnp.array([0]), jnp.array([1]),
+                     jnp.ones(1, bool))
+    f2 = union_edges(fresh_forest(n), jnp.array([1]), jnp.array([2]),
+                     jnp.ones(1, bool))
+    f3 = union_edges(fresh_forest(n), jnp.array([5]), jnp.array([6]),
+                     jnp.ones(1, bool))
+    stacked = jnp.stack([f1, f2, f3])
+    m = merge_forest_stack(stacked)
+    pairwise = merge_forests(merge_forests(f1, f2), f3)
+    np.testing.assert_array_equal(np.asarray(m), np.asarray(pairwise))
+    lab = labels_of(m, 8)
+    assert lab[0] == lab[1] == lab[2] == 0
+    assert lab[5] == lab[6] == 5
+
+
+def test_component_labels_unseen_is_minus_one():
+    p = fresh_forest(8)
+    seen = jnp.zeros(8, bool).at[jnp.array([0, 1])].set(True)
+    p = union_edges(p, jnp.array([0]), jnp.array([1]), jnp.ones(1, bool))
+    lab = np.asarray(component_labels(p, seen))
+    assert lab.tolist() == [0, 0, -1, -1, -1, -1, -1, -1]
