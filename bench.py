@@ -1,0 +1,146 @@
+"""Benchmark: streaming Connected Components edges/sec (north-star config).
+
+Runs the BASELINE.json north-star workload — streaming CC over a synthetic
+power-law edge stream — on the available accelerator, and measures the CPU
+baseline in-process (the reference publishes no numbers, BASELINE.md: the
+baseline must be measured, not quoted). The baseline is a faithful
+re-implementation of the reference's per-edge fold semantics in host Python:
+``DisjointSet.union`` with path compression per edge
+(``/root/reference/src/main/java/org/apache/flink/graph/streaming/summaries/DisjointSet.java:66-118``),
+folded edge-by-edge as ``UpdateCC`` does
+(``.../library/ConnectedComponents.java:82-87``).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def synth_edges(num_edges: int, num_vertices: int, seed: int = 7):
+    """Power-law-ish edge stream (Zipf endpoints, the skew CC cares about)."""
+    rng = np.random.default_rng(seed)
+    # Zipf over a permuted id space so hot vertices are spread across slots.
+    a = 1.3
+    src = rng.zipf(a, size=num_edges) % num_vertices
+    dst = rng.zipf(a, size=num_edges) % num_vertices
+    perm = rng.permutation(num_vertices)
+    return perm[src].astype(np.int64), perm[dst].astype(np.int64)
+
+
+def baseline_cc(src: np.ndarray, dst: np.ndarray) -> tuple[dict, float]:
+    """Reference-semantics per-edge union-find fold on host CPU."""
+    parent: dict[int, int] = {}
+
+    def find(x: int) -> int:
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:  # path compression
+            parent[x], x = root, parent[x]
+        return root
+
+    t0 = time.perf_counter()
+    for u, v in zip(src.tolist(), dst.tolist()):
+        if u not in parent:
+            parent[u] = u
+        if v not in parent:
+            parent[v] = v
+        ru, rv = find(u), find(v)
+        if ru != rv:
+            if ru < rv:
+                parent[rv] = ru
+            else:
+                parent[ru] = rv
+    dt = time.perf_counter() - t0
+    labels = {x: find(x) for x in parent}
+    return labels, dt
+
+
+def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int):
+    import jax
+
+    from gelly_tpu import edge_stream_from_edges  # noqa: F401  (registers x64)
+    from gelly_tpu.core.io import EdgeChunkSource
+    from gelly_tpu.core.stream import edge_stream_from_source
+    from gelly_tpu.library.connected_components import connected_components
+
+    def make_stream():
+        srcq = EdgeChunkSource(src, dst, chunk_size=chunk_size)
+        return edge_stream_from_source(srcq, num_vertices)
+
+    agg = connected_components(num_vertices, merge="gather")
+
+    # Warmup: compile fold/merge on a tiny prefix.
+    warm = EdgeChunkSource(src[: chunk_size * 2], dst[: chunk_size * 2],
+                           chunk_size=chunk_size)
+    warm_stream = edge_stream_from_source(warm, num_vertices)
+    warm_stream.aggregate(agg, merge_every=merge_every).result()
+
+    stream = make_stream()
+    t0 = time.perf_counter()
+    labels = stream.aggregate(agg, merge_every=merge_every).result()
+    jax.block_until_ready(labels)
+    dt = time.perf_counter() - t0
+    return labels, stream.ctx, dt
+
+
+def components_of(labels_by_id: dict) -> set[frozenset]:
+    comps: dict[int, set] = {}
+    for v, lbl in labels_by_id.items():
+        comps.setdefault(lbl, set()).add(v)
+    return {frozenset(c) for c in comps.values()}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--edges", type=int, default=2_000_000)
+    p.add_argument("--vertices", type=int, default=1 << 17)
+    p.add_argument("--chunk-size", type=int, default=1 << 17)
+    p.add_argument("--merge-every", type=int, default=4)
+    p.add_argument("--skip-parity", action="store_true")
+    args = p.parse_args()
+
+    src, dst = synth_edges(args.edges, args.vertices)
+
+    labels, ctx, dt_tpu = tpu_cc(
+        src, dst, args.vertices, args.chunk_size, args.merge_every
+    )
+    eps = args.edges / dt_tpu
+
+    base_labels, dt_base = baseline_cc(src, dst)
+    base_eps = args.edges / dt_base
+
+    if not args.skip_parity:
+        lab = np.asarray(labels)
+        slots = np.nonzero(lab >= 0)[0]
+        raw = ctx.decode(slots)
+        ours = components_of(
+            {int(r): int(lab[s]) for s, r in zip(slots, raw)}
+        )
+        theirs = components_of(base_labels)
+        if ours != theirs:
+            print(
+                json.dumps({"error": "label parity FAILED",
+                            "ours": len(ours), "theirs": len(theirs)}),
+                file=sys.stderr,
+            )
+            return 1
+
+    print(json.dumps({
+        "metric": "streaming_cc_throughput",
+        "value": round(eps, 1),
+        "unit": "edges/sec",
+        "vs_baseline": round(eps / base_eps, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

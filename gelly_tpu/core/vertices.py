@@ -9,8 +9,8 @@ ingest on the host and never appear on device.
 
 Two tables:
 
-- :class:`VertexTable` — growable dict-based raw→slot mapping for arbitrary
-  (sparse / 64-bit / hashed) id spaces.
+- :class:`VertexTable` — growable raw→slot mapping (sorted-array +
+  ``searchsorted``, fully vectorized) for arbitrary sparse/64-bit id spaces.
 - :class:`IdentityVertexTable` — zero-cost pass-through when ids are already
   dense integers in ``[0, capacity)`` (the fast path for benchmark graphs).
 """
@@ -26,54 +26,81 @@ class VertexTable:
     ``capacity`` (when set, e.g. by the stream context binding this table)
     bounds the slot space; encoding more distinct ids than that raises instead
     of silently corrupting device summaries sized to the capacity.
+
+    Internals are fully vectorized (no per-id Python loop): known ids live in
+    a sorted array probed with ``searchsorted``; a batch is resolved with one
+    ``np.unique`` + one probe, and new ids are appended in batch-sorted order.
     """
 
     def __init__(self, capacity: int | None = None):
-        self._map: dict[int, int] = {}
-        self._rev: list[int] = []
+        self._sorted_ids = np.empty(0, np.int64)  # known raw ids, sorted
+        self._sorted_slots = np.empty(0, np.int32)  # slot of _sorted_ids[i]
+        self._rev = np.empty(0, np.int64)  # slot -> raw id
         self.capacity = capacity
 
     def __len__(self) -> int:
-        return len(self._rev)
+        return int(self._rev.shape[0])
 
     @property
     def num_vertices(self) -> int:
-        return len(self._rev)
+        return len(self)
 
     def encode(self, raw_ids: np.ndarray) -> np.ndarray:
         """Map raw ids to dense slots, assigning new slots for unseen ids."""
-        raw_ids = np.asarray(raw_ids).ravel()
-        out = np.empty(raw_ids.shape[0], dtype=np.int32)
-        m = self._map
-        rev = self._rev
-        cap = self.capacity
-        for i, r in enumerate(raw_ids.tolist()):
-            s = m.get(r)
-            if s is None:
-                s = len(rev)
-                if cap is not None and s >= cap:
-                    raise ValueError(
-                        f"vertex table overflow: more than {cap} distinct "
-                        f"vertex ids in the stream (raise vertex_capacity)"
-                    )
-                m[r] = s
-                rev.append(r)
-            out[i] = s
-        return out
+        raw = np.asarray(raw_ids).ravel().astype(np.int64)
+        if raw.size == 0:
+            return np.empty(0, np.int32)
+        uniq, first_idx, inv = np.unique(
+            raw, return_index=True, return_inverse=True
+        )
+        if self._sorted_ids.shape[0]:
+            pos = np.minimum(
+                np.searchsorted(self._sorted_ids, uniq),
+                self._sorted_ids.shape[0] - 1,
+            )
+            known = self._sorted_ids[pos] == uniq
+            uniq_slots = np.where(known, self._sorted_slots[pos], -1).astype(
+                np.int32
+            )
+        else:
+            known = np.zeros(uniq.shape[0], bool)
+            uniq_slots = np.full(uniq.shape[0], -1, np.int32)
+        new_ids = uniq[~known]
+        if new_ids.size:
+            base = self._rev.shape[0]
+            if self.capacity is not None and base + new_ids.size > self.capacity:
+                raise ValueError(
+                    f"vertex table overflow: more than {self.capacity} "
+                    f"distinct vertex ids in the stream (raise vertex_capacity)"
+                )
+            # Slots follow first appearance in the batch (streaming parity:
+            # the reference assigns state entries in arrival order).
+            order = np.argsort(first_idx[~known], kind="stable")
+            new_slots = np.empty(new_ids.size, np.int32)
+            new_slots[order] = np.arange(
+                base, base + new_ids.size, dtype=np.int32
+            )
+            uniq_slots[~known] = new_slots
+            self._rev = np.concatenate([self._rev, new_ids[order]])
+            ins = np.searchsorted(self._sorted_ids, new_ids)
+            self._sorted_ids = np.insert(self._sorted_ids, ins, new_ids)
+            self._sorted_slots = np.insert(self._sorted_slots, ins, new_slots)
+        return uniq_slots[inv]
 
     def lookup(self, raw_ids: np.ndarray) -> np.ndarray:
         """Map raw ids to slots; unseen ids map to -1."""
-        raw_ids = np.asarray(raw_ids).ravel()
-        m = self._map
-        return np.fromiter(
-            (m.get(r, -1) for r in raw_ids.tolist()), dtype=np.int32,
-            count=raw_ids.shape[0],
+        raw = np.asarray(raw_ids).ravel().astype(np.int64)
+        if raw.size == 0 or self._sorted_ids.shape[0] == 0:
+            return np.full(raw.shape[0], -1, np.int32)
+        pos = np.minimum(
+            np.searchsorted(self._sorted_ids, raw), self._sorted_ids.shape[0] - 1
         )
+        known = self._sorted_ids[pos] == raw
+        return np.where(known, self._sorted_slots[pos], -1).astype(np.int32)
 
     def decode(self, slots: np.ndarray) -> np.ndarray:
         """Map dense slots back to raw ids."""
-        rev = np.asarray(self._rev, dtype=np.int64)
-        return rev[np.asarray(slots)]
+        return self._rev[np.asarray(slots)]
 
 
 class IdentityVertexTable:

@@ -49,7 +49,7 @@ from ..parallel.mesh import SHARD_AXIS
 Summary = Any
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class SummaryAggregation:
     """The four-knob plugin contract (M/SummaryAggregation.java:31-55).
 
@@ -71,6 +71,9 @@ class SummaryAggregation:
     combine: Callable[[Summary, Summary], Summary]
     transform: Callable[[Summary], Any] | None = None
     transient: bool = False
+    # transform is jitted per plan (device transforms, the default); set
+    # False for transforms doing host-side / non-traceable work.
+    jit_transform: bool = True
     merge_stacked: Callable[[Summary], Summary] | None = None
     name: str = "aggregation"
 
@@ -128,53 +131,33 @@ class SummaryStream:
         return last
 
 
-def run_aggregation(
-    agg: SummaryAggregation,
-    stream,
-    mesh=None,
-    merge_every: int | None = None,
-    window_ms: int | None = None,
-    checkpoint_path: str | None = None,
-    checkpoint_every: int = 1,
-    resume: bool = False,
-) -> SummaryStream:
-    """Execute ``agg`` over ``stream`` — the TPU ``run()``.
+def _compiled_plan(agg: SummaryAggregation, m):
+    # Jitted physical plans are memoized on the aggregation instance itself:
+    # jax.jit caches executables by function identity, so rebuilding the
+    # closures on every run_aggregation call would recompile the whole plan
+    # each time (~10s/program over the TPU tunnel). Storing on the instance
+    # ties the cache (and its compiled executables) to the agg's lifetime.
+    key = (tuple(d.id for d in m.devices.flat), m.axis_names)
+    per_agg = agg.__dict__.setdefault("_plan_cache", {})
+    if key in per_agg:
+        return per_agg[key]
 
-    ``merge_every`` (chunks) or ``window_ms`` (timestamp-tumbling) sets the
-    merge/emit cadence; default is merge_every=1 (a merge after every chunk,
-    the closest analog of the reference's per-window emission).
-
-    ``checkpoint_path`` snapshots the global summary + stream position every
-    ``checkpoint_every`` closed windows (the Merger's ListCheckpointed analog,
-    M/SummaryAggregation.java:127-135); ``resume=True`` reloads it and skips
-    the already-folded chunks.
-    """
-    if merge_every is not None and window_ms is not None:
-        raise ValueError("pass at most one of merge_every / window_ms")
-    if merge_every is None and window_ms is None:
-        merge_every = 1
-
-    m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)
-
     shard_leaf = lambda tree: jax.tree.map(lambda l: l[None], tree)
     unshard_leaf = lambda tree: jax.tree.map(lambda l: l[0], tree)
-
     sharded = NamedSharding(m, P(SHARD_AXIS))
 
-    # Fresh [S, ...]-stacked local summaries, built once and reused at every
-    # window close (jax arrays are immutable, so sharing is free).
-    locals0 = mesh_lib.device_put_sharded_leading(
-        m,
-        jax.tree.map(
-            lambda l: jnp.broadcast_to(l[None], (S,) + l.shape), agg.init()
-        ),
-    )
+    def locals0_fn():
+        # Fresh [S, ...]-stacked local summaries; rebuilt per run (cheap),
+        # reused at every window close (jax arrays are immutable).
+        return mesh_lib.device_put_sharded_leading(
+            m,
+            jax.tree.map(
+                lambda l: jnp.broadcast_to(l[None], (S,) + l.shape), agg.init()
+            ),
+        )
 
-    @partial(
-        jax.jit,
-        out_shardings=sharded,
-    )
+    @partial(jax.jit, out_shardings=sharded)
     def fold_step(locals_, chunk_split):
         def body(loc, ck):
             s = unshard_leaf(loc)
@@ -208,10 +191,55 @@ def run_aggregation(
         # incremental non-blocking global combine.
         return agg.combine(window_summary, global_summary)
 
-    split = jax.jit(
-        partial(partition.split_chunk, num_shards=S),
-        static_argnames=(),
-    )
+    split = jax.jit(partial(partition.split_chunk, num_shards=S))
+
+    # transform runs jitted by default: an eager lax.while_loop (e.g. the CC
+    # label pointer-jump) re-dispatches per call and dominates the window
+    # cost. Host-side transforms set jit_transform=False.
+    if agg.transform is None:
+        transform_fn = None
+    elif agg.jit_transform:
+        transform_fn = jax.jit(agg.transform)
+    else:
+        transform_fn = agg.transform
+
+    plan = (fold_step, merge_locals, merger_step, split, locals0_fn,
+            transform_fn)
+    per_agg[key] = plan
+    return plan
+
+
+def run_aggregation(
+    agg: SummaryAggregation,
+    stream,
+    mesh=None,
+    merge_every: int | None = None,
+    window_ms: int | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    resume: bool = False,
+) -> SummaryStream:
+    """Execute ``agg`` over ``stream`` — the TPU ``run()``.
+
+    ``merge_every`` (chunks) or ``window_ms`` (timestamp-tumbling) sets the
+    merge/emit cadence; default is merge_every=1 (a merge after every chunk,
+    the closest analog of the reference's per-window emission).
+
+    ``checkpoint_path`` snapshots the global summary + stream position every
+    ``checkpoint_every`` closed windows (the Merger's ListCheckpointed analog,
+    M/SummaryAggregation.java:127-135); ``resume=True`` reloads it and skips
+    the already-folded chunks.
+    """
+    if merge_every is not None and window_ms is not None:
+        raise ValueError("pass at most one of merge_every / window_ms")
+    if merge_every is None and window_ms is None:
+        merge_every = 1
+
+    m = mesh if mesh is not None else mesh_lib.make_mesh()
+    plan = _compiled_plan(agg, m)
+    (fold_step, merge_locals, merger_step, split, locals0_fn,
+     transform_fn) = plan
+    locals0 = locals0_fn()
 
     stats = {"late_edges": 0, "windows_closed": 0, "chunks": 0}
 
@@ -256,7 +284,7 @@ def run_aggregation(
             dirty = False
             windows_closed += 1
             stats["windows_closed"] = windows_closed
-            return agg.transform(out) if agg.transform else out
+            return transform_fn(out) if transform_fn else out
 
         def maybe_checkpoint(force=False):
             # Chunk-boundary-only checkpoints: every consumed edge is in
