@@ -1,0 +1,104 @@
+"""NeighborhoodStream — growing adjacency snapshots on device.
+
+TPU-native re-design of ``SimpleEdgeStream.buildNeighborhood``
+(``M/SimpleEdgeStream.java:531-560``): the reference keeps a per-key
+``HashMap<K, TreeSet<K>>`` and re-emits a vertex's adjacency set after every
+edge. Here the adjacency is a dense device ``bool[N, N]`` matrix updated by
+masked scatter, and emission is chunk-grained: one snapshot per processed
+chunk. Set membership, intersection (the triangle-count hot op,
+``M/example/ExactTriangleCount.java:74-116``) and neighbor iteration become
+row gathers / elementwise ANDs / popcounts — MXU/VPU-friendly, no pointer
+chasing.
+
+Memory: N² bytes (bool). N = the stream's vertex capacity by default; cap it
+via ``capacity`` for large id spaces (the exact-triangle path is meant for
+graphs that fit; the sampled estimators cover the rest).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .chunk import EdgeChunk
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("directed",))
+def _adj_step(adj, c: EdgeChunk, directed: bool):
+    src = jnp.where(c.valid, c.src, 0)
+    dst = jnp.where(c.valid, c.dst, 0)
+    adj = adj.at[src, dst].max(c.valid, mode="drop")
+    if not directed:
+        adj = adj.at[dst, src].max(c.valid, mode="drop")
+    return adj
+
+
+class NeighborhoodStream:
+    """Stream of growing adjacency snapshots (buildNeighborhood analog).
+
+    ``directed=False`` (the reference's default usage) stores both directions
+    of every edge, matching ``buildNeighborhood(false)`` routing through
+    ``undirected()`` (``M/SimpleEdgeStream.java:533-535``).
+    """
+
+    def __init__(self, stream, directed: bool = False,
+                 capacity: int | None = None):
+        self.stream = stream
+        self.directed = directed
+        self.capacity = (
+            int(capacity) if capacity is not None
+            else stream.ctx.vertex_capacity
+        )
+
+    def __iter__(self) -> Iterator[jax.Array]:
+        """Yield the adjacency snapshot after each chunk (chunk-grained
+        emission; the reference emits per edge — documented deviation, final
+        state identical)."""
+        n = self.capacity
+        adj = jnp.zeros((n, n), bool)
+        for c in self.stream:
+            self._check_range(c)
+            adj = _adj_step(adj, c, self.directed)
+            yield adj
+
+    def final_adjacency(self) -> jax.Array:
+        """Drained adjacency; cached so repeated queries (neighbors_of) don't
+        re-read the stream and rebuild the N² matrix."""
+        if getattr(self, "_final", None) is None:
+            adj = None
+            for adj in self:
+                pass
+            if adj is None:
+                adj = jnp.zeros((self.capacity, self.capacity), bool)
+            self._final = adj
+        return self._final
+
+    def _check_range(self, c: EdgeChunk):
+        # Guard against silent drop when capacity < stream vertex space.
+        if self.capacity < self.stream.ctx.vertex_capacity:
+            m = np.asarray(c.valid)
+            hi = max(
+                int(np.asarray(c.src)[m].max(initial=0)),
+                int(np.asarray(c.dst)[m].max(initial=0)),
+            )
+            if hi >= self.capacity:
+                raise ValueError(
+                    f"vertex slot {hi} exceeds neighborhood capacity "
+                    f"{self.capacity}"
+                )
+
+    def neighbors_of(self, raw_id: int) -> list[int]:
+        """Host query: sorted raw neighbor ids in the final adjacency —
+        the TreeSet view (M/SimpleEdgeStream.java:544-551)."""
+        ctx = self.stream.ctx
+        adj = self.final_adjacency()  # drains first: the table fills at ingest
+        slot = int(ctx.table.lookup(np.array([raw_id]))[0])
+        if slot < 0:
+            return []
+        row = np.asarray(adj[slot])
+        nbrs = np.nonzero(row)[0]
+        return sorted(ctx.decode(nbrs).tolist())

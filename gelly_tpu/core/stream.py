@@ -297,20 +297,23 @@ class EdgeStream:
 
         return run_aggregation(aggregation, self, **runner_kw)
 
-    def slice(self, window_ms: int, direction: str = "out") -> "SnapshotStream":
+    def slice(self, window_ms: int, direction: str = "out",
+              window_capacity: int | None = None) -> "SnapshotStream":
         """Discretize into per-vertex tumbling-window neighborhoods
         (M/SimpleEdgeStream.java:135-167). direction ∈ {out, in, all}."""
         from .snapshot import SnapshotStream
 
-        return SnapshotStream(self, window_ms, direction)
+        return SnapshotStream(self, window_ms, direction, window_capacity)
 
-    def build_neighborhood(self, directed: bool = False):
+    def build_neighborhood(self, directed: bool = False,
+                           capacity: int | None = None):
         """Stream of growing adjacency snapshots
-        (BuildNeighborhoods, M/SimpleEdgeStream.java:531-560); see
-        gelly_tpu.core.neighborhood."""
+        (BuildNeighborhoods, M/SimpleEdgeStream.java:531-560). ``capacity``
+        caps the N×N adjacency below the stream's vertex space (the exact
+        path's memory bound); see gelly_tpu.core.neighborhood."""
         from .neighborhood import NeighborhoodStream
 
-        return NeighborhoodStream(self, directed)
+        return NeighborhoodStream(self, directed, capacity)
 
 
 class DegreeStream:

@@ -28,13 +28,20 @@ class VertexTable:
     of silently corrupting device summaries sized to the capacity.
 
     Internals are fully vectorized (no per-id Python loop): known ids live in
-    a sorted array probed with ``searchsorted``; a batch is resolved with one
-    ``np.unique`` + one probe, and new ids are appended in batch-sorted order.
+    two sorted arrays probed with ``searchsorted`` — a large ``main`` region
+    and a small ``pending`` region that absorbs new ids cheaply (O(pending)
+    insert) and is merged into main only when it outgrows a threshold, so a
+    long stream of gradually-arriving ids costs amortized O(new) per batch
+    instead of an O(table) rebuild every chunk.
     """
 
+    _MERGE_THRESHOLD = 1 << 16
+
     def __init__(self, capacity: int | None = None):
-        self._sorted_ids = np.empty(0, np.int64)  # known raw ids, sorted
+        self._sorted_ids = np.empty(0, np.int64)  # main region, sorted
         self._sorted_slots = np.empty(0, np.int32)  # slot of _sorted_ids[i]
+        self._pend_ids = np.empty(0, np.int64)  # pending region, sorted
+        self._pend_slots = np.empty(0, np.int32)
         self._rev = np.empty(0, np.int64)  # slot -> raw id
         self.capacity = capacity
 
@@ -45,6 +52,14 @@ class VertexTable:
     def num_vertices(self) -> int:
         return len(self)
 
+    @staticmethod
+    def _probe(ids: np.ndarray, slots: np.ndarray, q: np.ndarray) -> np.ndarray:
+        """Slots for ``q`` against one sorted region; -1 where absent."""
+        if ids.shape[0] == 0:
+            return np.full(q.shape[0], -1, np.int32)
+        pos = np.minimum(np.searchsorted(ids, q), ids.shape[0] - 1)
+        return np.where(ids[pos] == q, slots[pos], -1).astype(np.int32)
+
     def encode(self, raw_ids: np.ndarray) -> np.ndarray:
         """Map raw ids to dense slots, assigning new slots for unseen ids."""
         raw = np.asarray(raw_ids).ravel().astype(np.int64)
@@ -53,19 +68,14 @@ class VertexTable:
         uniq, first_idx, inv = np.unique(
             raw, return_index=True, return_inverse=True
         )
-        if self._sorted_ids.shape[0]:
-            pos = np.minimum(
-                np.searchsorted(self._sorted_ids, uniq),
-                self._sorted_ids.shape[0] - 1,
+        uniq_slots = self._probe(self._sorted_ids, self._sorted_slots, uniq)
+        miss = uniq_slots < 0
+        if miss.any():
+            uniq_slots[miss] = self._probe(
+                self._pend_ids, self._pend_slots, uniq[miss]
             )
-            known = self._sorted_ids[pos] == uniq
-            uniq_slots = np.where(known, self._sorted_slots[pos], -1).astype(
-                np.int32
-            )
-        else:
-            known = np.zeros(uniq.shape[0], bool)
-            uniq_slots = np.full(uniq.shape[0], -1, np.int32)
-        new_ids = uniq[~known]
+        new = uniq_slots < 0
+        new_ids = uniq[new]
         if new_ids.size:
             base = self._rev.shape[0]
             if self.capacity is not None and base + new_ids.size > self.capacity:
@@ -75,28 +85,39 @@ class VertexTable:
                 )
             # Slots follow first appearance in the batch (streaming parity:
             # the reference assigns state entries in arrival order).
-            order = np.argsort(first_idx[~known], kind="stable")
+            order = np.argsort(first_idx[new], kind="stable")
             new_slots = np.empty(new_ids.size, np.int32)
             new_slots[order] = np.arange(
                 base, base + new_ids.size, dtype=np.int32
             )
-            uniq_slots[~known] = new_slots
+            uniq_slots[new] = new_slots
             self._rev = np.concatenate([self._rev, new_ids[order]])
-            ins = np.searchsorted(self._sorted_ids, new_ids)
-            self._sorted_ids = np.insert(self._sorted_ids, ins, new_ids)
-            self._sorted_slots = np.insert(self._sorted_slots, ins, new_slots)
+            ins = np.searchsorted(self._pend_ids, new_ids)
+            self._pend_ids = np.insert(self._pend_ids, ins, new_ids)
+            self._pend_slots = np.insert(self._pend_slots, ins, new_slots)
+            if self._pend_ids.shape[0] > self._MERGE_THRESHOLD:
+                self._merge_pending()
         return uniq_slots[inv]
+
+    def _merge_pending(self):
+        ids = np.concatenate([self._sorted_ids, self._pend_ids])
+        slots = np.concatenate([self._sorted_slots, self._pend_slots])
+        order = np.argsort(ids, kind="stable")
+        self._sorted_ids = ids[order]
+        self._sorted_slots = slots[order]
+        self._pend_ids = np.empty(0, np.int64)
+        self._pend_slots = np.empty(0, np.int32)
 
     def lookup(self, raw_ids: np.ndarray) -> np.ndarray:
         """Map raw ids to slots; unseen ids map to -1."""
         raw = np.asarray(raw_ids).ravel().astype(np.int64)
-        if raw.size == 0 or self._sorted_ids.shape[0] == 0:
+        if raw.size == 0:
             return np.full(raw.shape[0], -1, np.int32)
-        pos = np.minimum(
-            np.searchsorted(self._sorted_ids, raw), self._sorted_ids.shape[0] - 1
-        )
-        known = self._sorted_ids[pos] == raw
-        return np.where(known, self._sorted_slots[pos], -1).astype(np.int32)
+        out = self._probe(self._sorted_ids, self._sorted_slots, raw)
+        miss = out < 0
+        if miss.any():
+            out[miss] = self._probe(self._pend_ids, self._pend_slots, raw[miss])
+        return out
 
     def decode(self, slots: np.ndarray) -> np.ndarray:
         """Map dense slots back to raw ids."""

@@ -1,0 +1,65 @@
+"""Shared tumbling-window event iterator.
+
+One implementation of the reference's tumbling time-window semantics
+(``timeWindow(timeMillis)`` / ``slice``; ascending-timestamp contract with
+allowedLateness=0) consumed by both the aggregation engine's ``window_ms``
+path and the SnapshotStream buffer — a single place for window-boundary and
+late-edge policy.
+
+Yields events in stream order:
+
+- ``("edges", window, masked_chunk, n_valid)`` — a chunk masked down to the
+  edges of ``window`` (n_valid = host count of live edges in the mask);
+- ``("close", window, None, 0)`` — emitted when a later window's first edge
+  arrives (windows with no data never fire, Flink semantics) and once at
+  end-of-stream for the final partial window.
+
+Late edges (timestamp before the currently open window) are dropped and
+counted in ``stats["late_edges"]``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from .chunk import EdgeChunk
+
+
+def tumbling_window_events(
+    chunks: Iterable[EdgeChunk], window_ms: int, stats: dict | None = None,
+    initial_window: int | None = None,
+) -> Iterator[tuple]:
+    """``initial_window`` seeds the open window (checkpoint resume: edges of
+    earlier, already-emitted windows count as late instead of re-opening)."""
+    if stats is None:
+        stats = {}
+    stats.setdefault("late_edges", 0)
+    current = initial_window
+    dirty = False
+    for c in chunks:
+        ts = np.asarray(c.ts)
+        ok = np.asarray(c.valid)
+        if not ok.any():
+            continue
+        tw = ts // window_ms
+        if current is not None:
+            n_late = int((ok & (tw < current)).sum())
+            if n_late:
+                stats["late_edges"] += n_late
+                ok = ok & (tw >= current)
+        for w in np.unique(tw[ok]).tolist():
+            if current is None:
+                current = w
+            if w > current:
+                if dirty:
+                    yield ("close", current, None, 0)
+                    dirty = False
+                current = w
+            mask = ok & (tw == w)
+            yield ("edges", w, c.mask(jnp.asarray(mask)), int(mask.sum()))
+            dirty = True
+    if dirty:
+        yield ("close", current, None, 0)

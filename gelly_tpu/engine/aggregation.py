@@ -312,47 +312,54 @@ def run_aggregation(
                 },
             )
 
-        for chunk in stream:
-            chunks_consumed += 1
-            stats["chunks"] = chunks_consumed
-            if chunks_consumed <= skip_until:
-                continue
-            if window_ms is not None:
-                # Tumbling timestamp windows. Host reads ts (cheap sync);
-                # windows with no data never fire (Flink semantics), and
-                # edges for already-closed windows are counted as late and
-                # dropped (ascending-timestamp contract, allowedLateness=0).
-                ts = np.asarray(chunk.ts)
-                ok = np.asarray(chunk.valid)
-                if ok.any():
-                    tw = ts // window_ms
-                    if current_window is not None:
-                        n_late = int((ok & (tw < current_window)).sum())
-                        if n_late:
-                            stats["late_edges"] += n_late
-                            ok = ok & (tw >= current_window)
-                    for w in np.unique(tw[ok]).tolist():
-                        if current_window is None:
-                            current_window = w
-                        if w > current_window:
-                            if dirty:
-                                yield close_window()
-                            current_window = w
-                        mask = jnp.asarray(ok & (tw == w))
-                        locals_ = fold_step(locals_, split(chunk.mask(mask)))
-                        dirty = True
-            else:
+        def counted_chunks():
+            nonlocal chunks_consumed
+            for chunk in stream:
+                # In window mode checkpoints fire only here, at chunk
+                # boundaries: every edge of the chunks counted so far is in
+                # locals_ or global_summary, so the recorded position is
+                # consistent. (Mid-chunk "close" events are not safe points:
+                # the chunk's later-window edges are not folded yet.)
+                if window_ms is not None and chunks_consumed > skip_until:
+                    maybe_checkpoint()
+                chunks_consumed += 1
+                stats["chunks"] = chunks_consumed
+                if chunks_consumed <= skip_until:
+                    continue
+                yield chunk
+
+        if window_ms is not None:
+            # Tumbling timestamp windows via the shared iterator
+            # (core/windows.py): no-data windows never fire, late edges are
+            # dropped+counted (ascending-ts contract, allowedLateness=0).
+            from ..core.windows import tumbling_window_events
+
+            for kind, w, chunk, _n in tumbling_window_events(
+                counted_chunks(), window_ms, stats,
+                initial_window=current_window,
+            ):
+                if kind == "close":
+                    yield close_window()
+                else:
+                    current_window = w
+                    locals_ = fold_step(locals_, split(chunk))
+                    dirty = True
+            # The iterator closes the final partial window itself; just make
+            # sure the last state is durably checkpointed.
+            if checkpoint_path and stats["windows_closed"]:
+                maybe_checkpoint(force=True)
+        else:
+            for chunk in counted_chunks():
                 locals_ = fold_step(locals_, split(chunk))
                 chunks_in_window += 1
                 dirty = True
                 if chunks_in_window >= merge_every:
                     yield close_window()
                     chunks_in_window = 0
-            maybe_checkpoint()
-
-        if dirty:
-            yield close_window()
-            maybe_checkpoint(force=True)
+                maybe_checkpoint()
+            if dirty:
+                yield close_window()
+                maybe_checkpoint(force=True)
 
     out_stream = SummaryStream(gen)
     out_stream.stats = stats

@@ -1,3 +1,8 @@
+from .bipartiteness import (
+    BipartitenessResult,
+    bipartiteness_check,
+    to_candidates,
+)
 from .connected_components import (
     CCSummary,
     connected_components,

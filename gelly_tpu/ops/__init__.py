@@ -1,2 +1,2 @@
-from . import segments, unionfind
+from . import parity_unionfind, segments, unionfind
 from .hashset import DeviceHashSet

@@ -1,0 +1,123 @@
+"""Parity (signed) union-find — the bipartiteness summary kernel.
+
+TPU-native re-derivation of the reference's ``Candidates`` structure
+(``M/summaries/Candidates.java:27-197``): instead of per-component vertex
+maps with signs and a pairwise reversed-sign merge (``:142-192``), the state
+is a union-find forest with one extra **parity bit per vertex** (`rel[i]` =
+color difference between `i` and its parent). An edge (u, v) asserts
+parity(u) XOR parity(v) = 1 (the 2-coloring constraint encoded by
+``edgeToCandidate``'s +/- signs, ``M/library/BipartitenessCheck.java:54-61``);
+a union that would join two same-parity vertices of one component is an odd
+cycle — the ``fail()`` collapse (``M/summaries/Candidates.java:194-196``).
+
+Everything is fixpoint pointer-jumping + packed scatter-min hooking (the
+parity bit rides in the LSB of the packed parent word so parent and parity
+update atomically), array-wide under ``lax.while_loop`` — no data-dependent
+Python control flow.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .segments import masked_scatter_min
+
+
+class ParityForest(NamedTuple):
+    parent: jax.Array  # i32[N]
+    rel: jax.Array  # i32[N] in {0,1}: parity of i relative to parent[i]
+    failed: jax.Array  # bool[] — an odd cycle was observed (sticky)
+
+
+def fresh_parity_forest(capacity: int) -> ParityForest:
+    return ParityForest(
+        parent=jnp.arange(capacity, dtype=jnp.int32),
+        rel=jnp.zeros((capacity,), jnp.int32),
+        failed=jnp.zeros((), bool),
+    )
+
+
+def pointer_jump_parity(parent: jax.Array, rel: jax.Array):
+    """Full path compression carrying parity: rel' = rel ^ rel[parent]."""
+
+    def cond(s):
+        p, _ = s
+        return jnp.any(p[p] != p)
+
+    def body(s):
+        p, r = s
+        return p[p], r ^ r[p]
+
+    return jax.lax.while_loop(cond, body, (parent, rel))
+
+
+def union_edges_parity(f: ParityForest, u: jax.Array, v: jax.Array,
+                       q: jax.Array, valid: jax.Array) -> ParityForest:
+    """Union all valid (u, v) with required parity ``q`` between endpoints.
+
+    Graph edges use q=1 (endpoints differently colored); forest-merge edges
+    use q=rel (preserve the other forest's relative colors). Conflicts set
+    ``failed`` and are otherwise ignored (the forest stays consistent), the
+    array analog of Candidates.merge collapsing to (false, {}).
+    """
+
+    def body(state):
+        p, r, failed, _ = state
+        p, r = pointer_jump_parity(p, r)
+        ru, rv = p[u], p[v]
+        # Required parity between the two roots for this edge to hold.
+        link_q = r[u] ^ r[v] ^ q
+        same = ru == rv
+        failed = failed | jnp.any(valid & same & (link_q == 1))
+        live = valid & ~same
+        lo = jnp.minimum(ru, rv)
+        hi = jnp.maximum(ru, rv)
+        # Pack (parent, parity) so both update atomically under scatter-min;
+        # ties on the same (hi, lo) pair with opposite parity resolve to one
+        # link now and surface as a same-root conflict next iteration.
+        packed = p * 2 + r
+        packed2 = masked_scatter_min(packed, hi, lo * 2 + link_q, live)
+        p2, r2 = packed2 >> 1, packed2 & 1
+        return p2, r2, failed, jnp.any(p2 != p)
+
+    def cond(state):
+        return state[3]
+
+    p, r, failed, _ = jax.lax.while_loop(
+        cond, body, (f.parent, f.rel, f.failed, jnp.bool_(True))
+    )
+    p, r = pointer_jump_parity(p, r)
+    return ParityForest(p, r, failed)
+
+
+def merge_parity_forests(a: ParityForest, b: ParityForest) -> ParityForest:
+    """Merge forests: b's (i, parent[i], rel[i]) entries become constraint
+    edges — the analog of Candidates.merge unioning every entry of the other
+    candidate set (M/summaries/Candidates.java:77-139)."""
+    idx = jnp.arange(a.parent.shape[0], dtype=jnp.int32)
+    merged = union_edges_parity(
+        a._replace(failed=a.failed | b.failed),
+        idx, b.parent, b.rel, jnp.ones_like(idx, dtype=bool),
+    )
+    return merged
+
+
+def merge_parity_stack(stacked: ParityForest) -> ParityForest:
+    """Merge K stacked forests [K, N] in one fixpoint (cross-shard combine)."""
+    k, n = stacked.parent.shape
+    idx = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (k, n)).reshape(-1)
+    f = fresh_parity_forest(n)._replace(failed=jnp.any(stacked.failed))
+    return union_edges_parity(
+        f, idx, stacked.parent.reshape(-1), stacked.rel.reshape(-1),
+        jnp.ones((k * n,), bool),
+    )
+
+
+def two_coloring(f: ParityForest, seen: jax.Array):
+    """(labels, colors): component label (min slot) and parity color per seen
+    vertex; -1 labels for unseen."""
+    p, r = pointer_jump_parity(f.parent, f.rel)
+    return jnp.where(seen, p, -1), jnp.where(seen, r, -1)
