@@ -182,9 +182,12 @@ class SnapshotStream:
                 yield c
 
     def _windows(self) -> Iterator[tuple[int, NeighborhoodView]]:
-        """Assemble per-window sorted views (tumbling, ascending-ts)."""
+        """Assemble per-window sorted views (tumbling, ascending-ts).
+        ``stats`` reflects the most recent drain (reset per run)."""
         from .windows import tumbling_window_events
 
+        self.stats["late_edges"] = 0
+        self.stats["windows_closed"] = 0
         buf = None
         fill = jnp.int32(0)
         fill_host = 0

@@ -9,3 +9,12 @@ from .connected_components import (
     connected_components_tree,
     labels_to_components,
 )
+from .degrees import degree_distribution
+from .iterative_cc import IterativeCCStream
+from .matching import weighted_matching
+from .spanner import spanner, spanner_edges
+from .triangles import (
+    exact_triangle_count,
+    sampled_triangle_count,
+    window_triangles,
+)

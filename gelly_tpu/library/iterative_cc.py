@@ -1,0 +1,83 @@
+"""Iterative Connected Components — the feedback-loop runtime pattern.
+
+TPU-native re-design of ``M/example/IterativeConnectedComponents.java:43-229``:
+the reference uses a Flink streaming iteration (``edges.iterate()`` +
+``closeWith``) whose stateful body merges component sets per edge and feeds
+``(vertex, componentId)`` updates back into the loop. The idiomatic XLA
+equivalent of that asynchronous feedback channel is a per-chunk
+**min-label-propagation fixpoint**: a ``lax.while_loop`` scatters each
+edge's minimum endpoint label to both endpoints until no label changes —
+same final labels (component id = minimum vertex in the component, as the
+reference converges to), no feedback queue.
+
+This is deliberately a second, mechanism-distinct CC implementation next to
+the union-find aggregation (the reference also ships both); tests assert
+they agree.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from ..core.stream import Update
+from ..ops import segments
+
+
+@jax.jit
+def _propagate(labels, seen, chunk):
+    seen = segments.mark_seen(seen, chunk.src, chunk.valid)
+    seen = segments.mark_seen(seen, chunk.dst, chunk.valid)
+
+    def body(lab):
+        m = jnp.minimum(lab[chunk.src], lab[chunk.dst])
+        lab2 = segments.masked_scatter_min(lab, chunk.src, m, chunk.valid)
+        lab2 = segments.masked_scatter_min(lab2, chunk.dst, m, chunk.valid)
+        # Label-pointer chase: lab[x] = y asserts x~y, so folding in
+        # lab[lab] relabels members of components merged by EARLIER chunks
+        # (the feedback channel's transitive relabeling, without which a
+        # vertex absent from this chunk would keep its stale label).
+        return jnp.minimum(lab2, lab2[lab2])
+
+    def cond_changed(state):
+        lab, changed = state
+        return changed
+
+    def step(state):
+        lab, _ = state
+        lab2 = body(lab)
+        return lab2, jnp.any(lab2 != lab)
+
+    labels, _ = jax.lax.while_loop(
+        cond_changed, step, (labels, jnp.bool_(True))
+    )
+    return labels, seen
+
+
+class IterativeCCStream:
+    """Per-chunk (vertex, label) updates; labels improve monotonically as
+    more edges arrive — the reference's continuously-emitted relabels."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __iter__(self) -> Iterator[Update]:
+        n = self.stream.ctx.vertex_capacity
+        labels = jnp.arange(n, dtype=jnp.int32)
+        seen = jnp.zeros((n,), bool)
+        for c in self.stream:
+            labels, seen = _propagate(labels, seen, c)
+            ids = jnp.concatenate([c.src, c.dst])
+            ok = jnp.concatenate([c.valid, c.valid])
+            yield Update(ids, labels[ids], ok)
+
+    def final_labels(self):
+        labels = None
+        n = self.stream.ctx.vertex_capacity
+        lab = jnp.arange(n, dtype=jnp.int32)
+        seen = jnp.zeros((n,), bool)
+        for c in self.stream:
+            lab, seen = _propagate(lab, seen, c)
+        return jnp.where(seen, lab, -1)

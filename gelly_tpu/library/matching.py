@@ -1,0 +1,109 @@
+"""Greedy ½-approximation weighted matching (centralized stage).
+
+TPU-native re-design of ``M/example/CentralizedWeightedMatching.java:36-113``:
+the reference is a parallelism-1 stateful flatMap holding a ``Set<Edge>``; a
+new edge evicts its colliding matched edges iff its weight exceeds twice
+their combined weight. Here the matching lives in two dense device arrays —
+``partner[i32 N]`` (-1 = unmatched) and ``weight[f32 N]`` (stored at both
+endpoints) — and the inherently sequential per-edge decision runs as a
+``lax.scan`` per chunk on a single device (the stage is centralized in the
+reference too, ``:59-60``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MatchingState(NamedTuple):
+    partner: jax.Array  # i32[N], -1 unmatched
+    weight: jax.Array  # f32[N] weight of the matched edge at this endpoint
+
+
+@jax.jit
+def _matching_step(state: MatchingState, chunk) -> MatchingState:
+    def step(s, inp):
+        u, v, w, ok = inp
+        partner, weight = s
+        pu, pv = partner[u], partner[v]
+        # Colliding matched edges: u's and v's current matches. If u and v
+        # are matched to each other that is one edge, not two.
+        wu = jnp.where(pu >= 0, weight[u], 0.0)
+        wv = jnp.where(pv >= 0, weight[v], 0.0)
+        same_edge = (pu == v) & (pv == u) & (pu >= 0)
+        coll_sum = jnp.where(same_edge, wu, wu + wv)
+        take = ok & (u != v) & (w > 2.0 * coll_sum)
+        # Evict collisions: clear u's and v's partners (and their partners).
+        def clear(partner, weight, x, px):
+            do = take & (px >= 0)
+            partner = partner.at[px].set(
+                jnp.where(do, -1, partner[px]))
+            weight = weight.at[px].set(jnp.where(do, 0.0, weight[px]))
+            partner = partner.at[x].set(jnp.where(do, -1, partner[x]))
+            weight = weight.at[x].set(jnp.where(do, 0.0, weight[x]))
+            return partner, weight
+
+        partner, weight = clear(partner, weight, u, pu)
+        partner, weight = clear(partner, weight, v, pv)
+        # Add (u, v, w).
+        partner = partner.at[u].set(jnp.where(take, v, partner[u]))
+        partner = partner.at[v].set(jnp.where(take, u, partner[v]))
+        weight = weight.at[u].set(jnp.where(take, w, weight[u]))
+        weight = weight.at[v].set(jnp.where(take, w, weight[v]))
+        return MatchingState(partner, weight), None
+
+    out, _ = jax.lax.scan(
+        step, state,
+        (chunk.src, chunk.dst, chunk.val.astype(jnp.float32), chunk.valid),
+    )
+    return out
+
+
+class WeightedMatchingStream:
+    """Iterate for per-chunk states; ``final_matching`` returns the matched
+    raw-id edge set and ``total_weight`` its weight."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __iter__(self) -> Iterator[MatchingState]:
+        n = self.stream.ctx.vertex_capacity
+        state = MatchingState(
+            partner=jnp.full((n,), -1, jnp.int32),
+            weight=jnp.zeros((n,), jnp.float32),
+        )
+        for c in self.stream:
+            state = _matching_step(state, c)
+            yield state
+
+    def final(self) -> MatchingState:
+        if getattr(self, "_final", None) is None:
+            state = None
+            for state in self:
+                pass
+            self._final = state
+        return self._final
+
+    def final_matching(self) -> list[tuple[int, int, float]]:
+        state = self.final()
+        ctx = self.stream.ctx
+        partner = np.asarray(state.partner)
+        weight = np.asarray(state.weight)
+        out = []
+        for u in np.nonzero(partner >= 0)[0].tolist():
+            v = int(partner[u])
+            if u < v:  # each matched pair once
+                ru, rv = ctx.decode(np.array([u, v])).tolist()
+                out.append((min(ru, rv), max(ru, rv), float(weight[u])))
+        return sorted(out)
+
+    def total_weight(self) -> float:
+        return sum(w for _, _, w in self.final_matching())
+
+
+def weighted_matching(stream) -> WeightedMatchingStream:
+    return WeightedMatchingStream(stream)

@@ -1,0 +1,91 @@
+"""Spanner property tests (set-level, as the reference's unit test does
+scenario-wise — T/util/AdjacencyListGraphTest.java:57-87; exact edge parity
+is order-dependent by design)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from gelly_tpu import edge_stream_from_edges
+from gelly_tpu.library.spanner import spanner, spanner_edges
+from gelly_tpu.parallel import mesh as mesh_lib
+
+
+def bfs_dist(adj: dict, a: int, b: int) -> float:
+    if a == b:
+        return 0
+    frontier, seen, d = {a}, {a}, 0
+    while frontier:
+        d += 1
+        frontier = {n for f in frontier for n in adj.get(f, ())} - seen
+        if b in frontier:
+            return d
+        seen |= frontier
+    return float("inf")
+
+
+def check_spanner_properties(edges, got, k):
+    eset = {frozenset(e) for e in edges}
+    # 1. spanner edges are input edges
+    for e in got:
+        assert frozenset(e) in eset, e
+    # 2. every input edge's endpoints within k hops in the spanner
+    adj: dict = {}
+    for a, b in got:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+    for a, b in edges:
+        assert bfs_dist(adj, a, b) <= k, (a, b)
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_spanner_properties_random_graph(k):
+    rng = np.random.default_rng(9)
+    verts = list(range(24))
+    edges = list({(int(a), int(b))
+                  for a, b in rng.integers(0, 24, (80, 2)) if a != b})
+    s = edge_stream_from_edges(edges, vertex_capacity=32, chunk_size=8)
+    agg = spanner(32, k)
+    summary = s.aggregate(agg, merge_every=2).result()
+    got = spanner_edges(summary, s.ctx)
+    check_spanner_properties(edges, got, k)
+    assert len(got) <= len(edges)
+
+
+def test_spanner_keeps_tree_edges():
+    # A tree has no redundant edges: the spanner must keep all of them.
+    edges = [(i, i + 1) for i in range(10)] + [(3, 20), (20, 21)]
+    s = edge_stream_from_edges(edges, vertex_capacity=32, chunk_size=4)
+    summary = s.aggregate(spanner(32, 3), merge_every=1).result()
+    got = spanner_edges(summary, s.ctx)
+    assert {frozenset(e) for e in got} == {frozenset(e) for e in edges}
+
+
+def test_spanner_prunes_dense_clique():
+    # K8 with k=2: once a hub path exists, most edges are within 2 hops.
+    edges = list(itertools.combinations(range(8), 2))
+    s = edge_stream_from_edges(edges, vertex_capacity=16, chunk_size=4)
+    summary = s.aggregate(spanner(16, 2), merge_every=1).result()
+    got = spanner_edges(summary, s.ctx)
+    check_spanner_properties(edges, got, 2)
+    assert len(got) < len(edges)  # must prune something in a clique
+
+
+def test_spanner_multi_shard_merge(devices):
+    m = mesh_lib.make_mesh(8)
+    rng = np.random.default_rng(4)
+    edges = list({(int(a), int(b))
+                  for a, b in rng.integers(0, 16, (60, 2)) if a != b})
+    s = edge_stream_from_edges(edges, vertex_capacity=16, chunk_size=8)
+    summary = s.aggregate(spanner(16, 2), mesh=m, merge_every=2).result()
+    got = spanner_edges(summary, s.ctx)
+    check_spanner_properties(edges, got, 2)
+
+
+def test_spanner_overflow_flag():
+    edges = [(i, i + 1) for i in range(10)]
+    s = edge_stream_from_edges(edges, vertex_capacity=16, chunk_size=4)
+    summary = s.aggregate(spanner(16, 2, max_edges=4), merge_every=1).result()
+    with pytest.raises(RuntimeError, match="overflow"):
+        spanner_edges(summary, s.ctx)
