@@ -1,0 +1,30 @@
+"""Streaming Connected Components (ConnectedComponentsExample.java:49-169).
+
+Usage: python examples/connected_components.py [<edges path> <merge every chunks>]
+Prints (vertex, component) pairs after each merge window.
+"""
+
+import sys
+
+from _util import arg, sequence_default_edges, stream_from_args
+
+from gelly_tpu.library.connected_components import (
+    connected_components,
+    labels_to_components,
+)
+
+
+def main(args):
+    stream = stream_from_args(args, default_edges=sequence_default_edges())
+    merge_every = arg(args, 1, 4)
+    agg = connected_components(stream.ctx.vertex_capacity)
+    result = stream.aggregate(agg, merge_every=merge_every)
+    labels = None
+    for labels in result:
+        pass  # continuously-improving summaries; print the final one
+    for comp in labels_to_components(labels, stream.ctx):
+        print(f"{comp[0]}: {comp}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

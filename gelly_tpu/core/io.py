@@ -55,10 +55,18 @@ def parse_edge_list_text(
         if not line or any(line.startswith(p) for p in comment_prefixes):
             continue
         fields = line.split(delimiter) if delimiter else line.split()
-        srcs.append(int(fields[0]))
-        dsts.append(int(fields[1]))
+        try:  # malformed lines are skipped (native parser parity)
+            s, d = int(fields[0]), int(fields[1])
+        except (ValueError, IndexError):
+            continue
+        srcs.append(s)
+        dsts.append(d)
         if num_value_cols:
-            vals.append(float(fields[2]))
+            # Missing value column defaults to 1.0 (native parser parity).
+            try:
+                vals.append(float(fields[2]))
+            except (ValueError, IndexError):
+                vals.append(1.0)
     src = np.asarray(srcs, dtype=np.int64)
     dst = np.asarray(dsts, dtype=np.int64)
     val = np.asarray(vals, dtype=np.float64) if num_value_cols else None
@@ -73,10 +81,12 @@ def read_edge_list(
     use_native: bool = True,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
     """Read a whole edge-list file into numpy arrays (host)."""
-    if use_native and num_value_cols == 0 and delimiter is None:
+    if use_native and delimiter is None:
         try:
             from ..utils.native import parse_edge_list_file
 
+            if num_value_cols:
+                return parse_edge_list_file(path, want_vals=True)
             return (*parse_edge_list_file(path), None)
         except Exception:
             pass  # fall back to the pure-python parser

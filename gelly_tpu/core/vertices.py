@@ -17,6 +17,8 @@ Two tables:
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 
@@ -44,6 +46,10 @@ class VertexTable:
         self._pend_slots = np.empty(0, np.int32)
         self._rev = np.empty(0, np.int64)  # slot -> raw id
         self.capacity = capacity
+        # encode runs on the prefetch thread while consumers call
+        # lookup/decode from the main thread; the multi-array updates are
+        # not atomic, so all table accesses serialize on this lock.
+        self._lock = threading.Lock()
 
     def __len__(self) -> int:
         return int(self._rev.shape[0])
@@ -65,6 +71,10 @@ class VertexTable:
         raw = np.asarray(raw_ids).ravel().astype(np.int64)
         if raw.size == 0:
             return np.empty(0, np.int32)
+        with self._lock:
+            return self._encode_locked(raw)
+
+    def _encode_locked(self, raw: np.ndarray) -> np.ndarray:
         uniq, first_idx, inv = np.unique(
             raw, return_index=True, return_inverse=True
         )
@@ -113,15 +123,19 @@ class VertexTable:
         raw = np.asarray(raw_ids).ravel().astype(np.int64)
         if raw.size == 0:
             return np.full(raw.shape[0], -1, np.int32)
-        out = self._probe(self._sorted_ids, self._sorted_slots, raw)
-        miss = out < 0
-        if miss.any():
-            out[miss] = self._probe(self._pend_ids, self._pend_slots, raw[miss])
-        return out
+        with self._lock:
+            out = self._probe(self._sorted_ids, self._sorted_slots, raw)
+            miss = out < 0
+            if miss.any():
+                out[miss] = self._probe(
+                    self._pend_ids, self._pend_slots, raw[miss]
+                )
+            return out
 
     def decode(self, slots: np.ndarray) -> np.ndarray:
         """Map dense slots back to raw ids."""
-        return self._rev[np.asarray(slots)]
+        with self._lock:
+            return self._rev[np.asarray(slots)]
 
 
 class IdentityVertexTable:

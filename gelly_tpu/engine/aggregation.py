@@ -218,12 +218,16 @@ def run_aggregation(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 1,
     resume: bool = False,
+    prefetch_depth: int = 2,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
 
     ``merge_every`` (chunks) or ``window_ms`` (timestamp-tumbling) sets the
     merge/emit cadence; default is merge_every=1 (a merge after every chunk,
     the closest analog of the reference's per-window emission).
+
+    ``prefetch_depth`` chunks of host ingest (parse/densify/H2D) overlap
+    device folds on a background thread; 0 disables.
 
     ``checkpoint_path`` snapshots the global summary + stream position every
     ``checkpoint_every`` closed windows (the Merger's ListCheckpointed analog,
@@ -312,9 +316,11 @@ def run_aggregation(
                 },
             )
 
+        from ..utils.prefetch import prefetch
+
         def counted_chunks():
             nonlocal chunks_consumed
-            for chunk in stream:
+            for chunk in prefetch(iter(stream), prefetch_depth):
                 # In window mode checkpoints fire only here, at chunk
                 # boundaries: every edge of the chunks counted so far is in
                 # locals_ or global_summary, so the recorded position is

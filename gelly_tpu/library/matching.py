@@ -81,11 +81,16 @@ class WeightedMatchingStream:
             yield state
 
     def final(self) -> MatchingState:
-        if getattr(self, "_final", None) is None:
-            state = None
+        if not getattr(self, "_drained", False):
+            n = self.stream.ctx.vertex_capacity
+            state = MatchingState(
+                partner=jnp.full((n,), -1, jnp.int32),
+                weight=jnp.zeros((n,), jnp.float32),
+            )  # empty-stream result
             for state in self:
                 pass
             self._final = state
+            self._drained = True
         return self._final
 
     def final_matching(self) -> list[tuple[int, int, float]]:

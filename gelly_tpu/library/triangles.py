@@ -168,11 +168,17 @@ class ExactTriangleStream:
             yield state
 
     def final(self) -> TriangleCounts:
-        if getattr(self, "_final", None) is None:
-            state = None
+        if not getattr(self, "_drained", False):
+            n = self.capacity
+            state = TriangleCounts(
+                adj=jnp.zeros((n, n), bool),
+                counts=jnp.zeros((n,), jnp.int64),
+                total=jnp.zeros((), jnp.int64),
+            )  # empty-stream result
             for state in self:
                 pass
             self._final = state
+            self._drained = True
         return self._final
 
     def final_counts(self) -> dict[int, int]:

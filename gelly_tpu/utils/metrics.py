@@ -1,0 +1,96 @@
+"""Observability: throughput meters, stage timers, profiler hook.
+
+The reference has none of this in-repo (SURVEY.md §5: only a
+``getNetRuntime()`` printout, ``CentralizedWeightedMatching.java:62-64``;
+Flink's web UI is never referenced) — the TPU framework owns it instead:
+
+- :class:`StageTimer` — named accumulated wall-clock per pipeline stage;
+- :class:`ThroughputMeter` — edges/sec over a window of samples;
+- :func:`metered` — wrap any chunk iterator to count edges + time without
+  touching the pipeline;
+- :func:`trace` — context manager around ``jax.profiler`` for device traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Iterable, Iterator
+
+import numpy as np
+
+
+class StageTimer:
+    """Accumulates wall-clock per named stage: ``with timer("fold"): ...``"""
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def __call__(self, stage: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[stage] += time.perf_counter() - t0
+            self.counts[stage] += 1
+
+    def report(self) -> dict[str, dict[str, float]]:
+        return {
+            s: {
+                "total_s": round(self.totals[s], 6),
+                "calls": self.counts[s],
+                "mean_ms": round(1e3 * self.totals[s] / self.counts[s], 3),
+            }
+            for s in self.totals
+        }
+
+
+class ThroughputMeter:
+    """Running edges/sec: ``meter.record(n)`` after each batch."""
+
+    def __init__(self):
+        self.edges = 0
+        self.start = None
+        self.last = None
+
+    def record(self, n: int):
+        now = time.perf_counter()
+        if self.start is None:
+            self.start = now
+        self.edges += int(n)
+        self.last = now
+
+    @property
+    def elapsed(self) -> float:
+        if self.start is None:
+            return 0.0
+        return (self.last or self.start) - self.start
+
+    @property
+    def edges_per_sec(self) -> float:
+        return self.edges / self.elapsed if self.elapsed > 0 else 0.0
+
+
+def metered(chunks: Iterable, meter: ThroughputMeter) -> Iterator:
+    """Pass-through chunk iterator feeding ``meter`` with valid-edge counts."""
+    for c in chunks:
+        meter.record(int(np.asarray(c.valid).sum()))
+        yield c
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Device-level profiling via jax.profiler; no-op when log_dir is None."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,95 @@
+"""ctypes bindings for the native runtime components.
+
+Builds ``native/edgelist_parser.cc`` with g++ on first use (cached as a
+shared object next to the source; no pip/pybind dependency) and exposes
+
+- :func:`parse_edge_list_file` — int64 COO arrays straight from disk, with
+  the comment/whitespace conventions of the reference's readers.
+
+Import failures (no compiler, read-only tree) degrade gracefully: callers
+(``core/io.py``) fall back to the pure-numpy parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "edgelist_parser.cc"))
+_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "libedgelist_parser.so"))
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> None:
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+        check=True, capture_output=True,
+    )
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.parse_edge_list.restype = ctypes.c_int
+        lib.parse_edge_list.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.free_edge_buffers.restype = None
+        lib.free_edge_buffers.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        _lib = lib
+        return lib
+
+
+def parse_edge_list_file(path: str, want_vals: bool = False):
+    """(src[i64], dst[i64][, val[f64]]) numpy arrays from an edge-list file."""
+    lib = _load()
+    src_p = ctypes.POINTER(ctypes.c_int64)()
+    dst_p = ctypes.POINTER(ctypes.c_int64)()
+    val_p = ctypes.POINTER(ctypes.c_double)()
+    n = ctypes.c_int64()
+    rc = lib.parse_edge_list(
+        path.encode(), ctypes.byref(src_p), ctypes.byref(dst_p),
+        ctypes.byref(val_p), 1 if want_vals else 0, ctypes.byref(n),
+    )
+    if rc == 1:
+        raise FileNotFoundError(path)
+    if rc != 0:
+        raise MemoryError(f"native parser failed with code {rc}")
+    count = n.value
+    try:
+        src = np.ctypeslib.as_array(src_p, (count,)).copy() if count else \
+            np.empty(0, np.int64)
+        dst = np.ctypeslib.as_array(dst_p, (count,)).copy() if count else \
+            np.empty(0, np.int64)
+        if want_vals:
+            val = np.ctypeslib.as_array(val_p, (count,)).copy() if count else \
+                np.empty(0, np.float64)
+    finally:
+        lib.free_edge_buffers(src_p, dst_p, val_p if want_vals else None)
+    if want_vals:
+        return src, dst, val
+    return src, dst

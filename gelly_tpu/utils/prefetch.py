@@ -1,0 +1,54 @@
+"""Background chunk prefetch — host↔device pipeline overlap.
+
+Sustained throughput needs ingest (parse, densify, pad, H2D transfer) to
+overlap device execution (SURVEY.md §7 hard-part #6: double buffering is
+first-class, not an afterthought). :func:`prefetch` drains an iterator on a
+daemon thread into a bounded queue, so chunk k+1's host work happens while
+the device folds chunk k. Exceptions re-raise at the consumer.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_DONE = object()
+
+
+class _Error:
+    """Private out-of-band wrapper: user items can never alias it."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Iterate ``it`` on a background thread, ``depth`` items ahead."""
+    if depth <= 0:
+        yield from it
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:  # re-raised at the consumer
+            q.put(_Error(e))
+        finally:
+            q.put(_DONE)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _DONE:
+            return
+        if isinstance(item, _Error):
+            raise item.exc
+        yield item

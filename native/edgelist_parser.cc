@@ -1,0 +1,178 @@
+// Native edge-list parser — the ingest hot path.
+//
+// The reference delegates text ingestion to Flink/JVM readers plus per-line
+// Java map functions (e.g. ExactTriangleCount.java:183-192,
+// ConnectedComponentsExample.java:105-118: split on whitespace, skip '%'
+// comments). This framework owns its runtime natively: a single-pass
+// byte-scanning parser (no line splitting, no regex) feeding int64 COO
+// buffers that Python wraps zero-copy via ctypes/numpy.
+//
+// Exposed C ABI (consumed by gelly_tpu/utils/native.py):
+//   parse_edge_list(path, &src, &dst, &val, want_vals, &n) -> 0 on success
+//   free_edge_buffers(src, dst, val)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// Grows-by-doubling int64/double buffers.
+struct Buf {
+  void* data = nullptr;
+  size_t len = 0;
+  size_t cap = 0;
+
+  bool push_i64(int64_t v) {
+    if (len == cap) {
+      size_t ncap = cap ? cap * 2 : 1 << 16;
+      void* nd = realloc(data, ncap * sizeof(int64_t));
+      if (!nd) return false;
+      data = nd;
+      cap = ncap;
+    }
+    static_cast<int64_t*>(data)[len++] = v;
+    return true;
+  }
+  bool push_f64(double v) {
+    if (len == cap) {
+      size_t ncap = cap ? cap * 2 : 1 << 16;
+      void* nd = realloc(data, ncap * sizeof(double));
+      if (!nd) return false;
+      data = nd;
+      cap = ncap;
+    }
+    static_cast<double*>(data)[len++] = v;
+    return true;
+  }
+};
+
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+inline const char* skip_line(const char* p, const char* end) {
+  while (p < end && *p != '\n') ++p;
+  return p < end ? p + 1 : end;
+}
+
+// Parses a signed integer; returns nullptr if none present.
+inline const char* parse_i64(const char* p, const char* end, int64_t* out) {
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) {
+    neg = (*p == '-');
+    ++p;
+  }
+  if (p >= end || *p < '0' || *p > '9') return nullptr;
+  int64_t v = 0;
+  while (p < end && *p >= '0' && *p <= '9') {
+    v = v * 10 + (*p - '0');
+    ++p;
+  }
+  *out = neg ? -v : v;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; 1 file error; 2 allocation failure.
+int parse_edge_list(const char* path, int64_t** src_out, int64_t** dst_out,
+                    double** val_out, int want_vals, int64_t* n_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return 1;
+  fseek(f, 0, SEEK_END);
+  long fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* text = static_cast<char*>(malloc(fsize ? fsize : 1));
+  if (!text) {
+    fclose(f);
+    return 2;
+  }
+  size_t got = fread(text, 1, fsize, f);
+  fclose(f);
+
+  Buf src, dst, val;
+  const char* p = text;
+  const char* end = text + got;
+  int rc = 0;
+  while (p < end) {
+    p = skip_ws(p, end);
+    if (p >= end) break;
+    if (*p == '\n') {
+      ++p;
+      continue;
+    }
+    if (*p == '%' || *p == '#') {
+      p = skip_line(p, end);
+      continue;
+    }
+    int64_t a, b;
+    const char* q = parse_i64(p, end, &a);
+    if (!q) {
+      p = skip_line(p, end);  // malformed line: skip (parser parity with
+      continue;               // the examples' lenient split-and-parse)
+    }
+    q = skip_ws(q, end);
+    q = parse_i64(q, end, &b);
+    if (!q) {
+      p = skip_line(p, end);
+      continue;
+    }
+    if (!src.push_i64(a) || !dst.push_i64(b)) {
+      rc = 2;
+      break;
+    }
+    if (want_vals) {
+      q = skip_ws(q, end);
+      int64_t iv;
+      double v = 1.0;
+      // Accept integer or simple decimal third column; default 1.0. Sign
+      // is tracked independently of the integer part so "-0.5" keeps it.
+      bool vneg = (q < end && *q == '-');
+      const char* r = parse_i64(q, end, &iv);
+      if (r != nullptr) {
+        double mag = static_cast<double>(iv < 0 ? -iv : iv);
+        if (r < end && *r == '.') {
+          ++r;
+          double frac = 0, scale = 1;
+          while (r < end && *r >= '0' && *r <= '9') {
+            frac = frac * 10 + (*r - '0');
+            scale *= 10;
+            ++r;
+          }
+          mag += frac / scale;
+        }
+        v = vneg ? -mag : mag;
+      }
+      if (!val.push_f64(v)) {
+        rc = 2;
+        break;
+      }
+    }
+    p = skip_line(q ? q : p, end);
+  }
+  free(text);
+  if (rc != 0) {
+    free(src.data);
+    free(dst.data);
+    free(val.data);
+    return rc;
+  }
+  *src_out = static_cast<int64_t*>(src.data);
+  *dst_out = static_cast<int64_t*>(dst.data);
+  *val_out = want_vals ? static_cast<double*>(val.data) : nullptr;
+  *n_out = static_cast<int64_t>(src.len);
+  return 0;
+}
+
+void free_edge_buffers(int64_t* src, int64_t* dst, double* val) {
+  free(src);
+  free(dst);
+  free(val);
+}
+
+}  // extern "C"

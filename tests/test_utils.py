@@ -1,0 +1,106 @@
+"""Native parser, metrics, and prefetch utilities."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gelly_tpu.utils.metrics import StageTimer, ThroughputMeter, metered
+from gelly_tpu.utils.prefetch import prefetch
+
+
+def test_prefetch_order_and_completion():
+    assert list(prefetch(iter(range(100)), depth=3)) == list(range(100))
+    assert list(prefetch(iter([]), depth=2)) == []
+    assert list(prefetch(iter([1]), depth=0)) == [1]
+
+
+def test_prefetch_propagates_exceptions():
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)
+
+
+def test_stage_timer_and_meter():
+    t = StageTimer()
+    with t("fold"):
+        pass
+    with t("fold"):
+        pass
+    rep = t.report()
+    assert rep["fold"]["calls"] == 2
+    m = ThroughputMeter()
+    m.record(100)
+    m.record(200)
+    assert m.edges == 300
+
+
+def test_metered_stream_counts_valid_edges(reference_edges):
+    from gelly_tpu import edge_stream_from_edges
+
+    s = edge_stream_from_edges(reference_edges, vertex_capacity=16, chunk_size=3)
+    m = ThroughputMeter()
+    n = sum(1 for _ in metered(iter(s), m))
+    assert n == 3  # ceil(7/3) chunks
+    assert m.edges == 7
+
+
+def _native_available():
+    try:
+        from gelly_tpu.utils.native import _load
+
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _native_available(), reason="no native toolchain")
+def test_native_parser_matches_python(tmp_path):
+    from gelly_tpu.core.io import parse_edge_list_text
+    from gelly_tpu.utils.native import parse_edge_list_file
+
+    p = tmp_path / "edges.txt"
+    p.write_text(
+        "% header\n1 2\n3\t4 9.5\n# comment\n  5 6\n\n-7 8\n"
+        "9000000000 9000000001\n"
+    )
+    ns, nd = parse_edge_list_file(str(p))
+    ps, pd, _ = parse_edge_list_text(p.read_text())
+    np.testing.assert_array_equal(ns, ps)
+    np.testing.assert_array_equal(nd, pd)
+    # valued path
+    ns2, nd2, nv = parse_edge_list_file(str(p), want_vals=True)
+    assert nv[1] == 9.5 and nv[0] == 1.0
+
+
+@pytest.mark.skipif(not _native_available(), reason="no native toolchain")
+def test_native_parser_feeds_stream(tmp_path):
+    from gelly_tpu import edge_stream_from_file
+
+    p = tmp_path / "edges.txt"
+    p.write_text("1 2\n2 3\n3 1\n")
+    s = edge_stream_from_file(str(p), vertex_capacity=16, chunk_size=2)
+    assert sorted((a, b) for a, b, _ in s.collect_edges()) == [
+        (1, 2), (2, 3), (3, 1)
+    ]
+
+
+def test_aggregation_with_prefetch_matches(reference_edges):
+    from gelly_tpu import edge_stream_from_edges
+    from gelly_tpu.library.connected_components import (
+        connected_components, labels_to_components,
+    )
+
+    edges = [(a, b) for a, b, _ in reference_edges] + [(6, 7), (8, 9)]
+    expected = [[1, 2, 3, 4, 5], [6, 7], [8, 9]]
+    for depth in (0, 3):
+        s = edge_stream_from_edges(edges, vertex_capacity=32, chunk_size=2)
+        agg = connected_components(32)
+        labels = s.aggregate(agg, merge_every=2, prefetch_depth=depth).result()
+        assert labels_to_components(labels, s.ctx) == expected, depth
