@@ -79,9 +79,12 @@ class EdgeChunk(NamedTuple):
         """
         return concat_chunks(self, self.reverse())
 
-    def mask(self, keep: jax.Array) -> "EdgeChunk":
+    def mask(self, keep) -> "EdgeChunk":
         """Return the chunk with ``valid &= keep`` (filter without moving data)."""
         return self._replace(valid=self.valid & keep)
+
+    def is_host(self) -> bool:
+        return isinstance(self.src, np.ndarray)
 
     def to_numpy(self) -> "EdgeChunk":
         return EdgeChunk(*(np.asarray(f) for f in self))
@@ -105,6 +108,7 @@ def make_chunk(
     event=None,
     capacity: int | None = None,
     val_dtype=jnp.float32,
+    device: bool = True,
 ) -> EdgeChunk:
     """Build a padded :class:`EdgeChunk` from host arrays.
 
@@ -112,6 +116,11 @@ def make_chunk(
     ``valid=False``. Padding slots use vertex 0 / value 0 and are never observed
     by kernels, which must respect ``valid``. ``raw_src``/``raw_dst`` default to
     the slot values (identity densification).
+
+    ``device=False`` keeps the fields as numpy: the H2D transfer then happens
+    lazily when a jitted consumer first touches the chunk, and host-side
+    window logic (timestamp reads, direction transforms) costs no device
+    round-trips — the right mode for ingest sources.
     """
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
@@ -136,15 +145,16 @@ def make_chunk(
     event = np.zeros((n,), dtype=np.int8) if event is None else event
     valid = np.zeros((cap,), dtype=bool)
     valid[:n] = True
+    put = jnp.asarray if device else (lambda a: a)
     return EdgeChunk(
-        src=jnp.asarray(pad(src, np.int32)),
-        dst=jnp.asarray(pad(dst, np.int32)),
-        raw_src=jnp.asarray(pad(raw_src, np.int64)),
-        raw_dst=jnp.asarray(pad(raw_dst, np.int64)),
-        val=jnp.asarray(pad(val, np.dtype(val_dtype))),
-        ts=jnp.asarray(pad(ts, np.int64)),
-        event=jnp.asarray(pad(event, np.int8)),
-        valid=jnp.asarray(valid),
+        src=put(pad(src, np.int32)),
+        dst=put(pad(dst, np.int32)),
+        raw_src=put(pad(raw_src, np.int64)),
+        raw_dst=put(pad(raw_dst, np.int64)),
+        val=put(pad(val, np.dtype(val_dtype))),
+        ts=put(pad(ts, np.int64)),
+        event=put(pad(event, np.int8)),
+        valid=put(valid),
     )
 
 
@@ -162,5 +172,7 @@ def empty_chunk(capacity: int, val_dtype=jnp.float32, val_shape=()) -> EdgeChunk
 
 
 def concat_chunks(a: EdgeChunk, b: EdgeChunk) -> EdgeChunk:
-    """Concatenate along the edge axis (capacity = a.capacity + b.capacity)."""
-    return EdgeChunk(*(jnp.concatenate([x, y], axis=0) for x, y in zip(a, b)))
+    """Concatenate along the edge axis (capacity = a.capacity + b.capacity).
+    Host chunks concatenate in numpy (no device round-trip)."""
+    xp = np if a.is_host() and b.is_host() else jnp
+    return EdgeChunk(*(xp.concatenate([x, y], axis=0) for x, y in zip(a, b)))

@@ -161,6 +161,7 @@ class EdgeChunkSource:
                 event=None if self.events is None else self.events[lo:hi],
                 capacity=cs,
                 val_dtype=self.val_dtype,
+                device=False,  # lazy H2D: host window logic stays host-side
             )
 
 

@@ -117,39 +117,42 @@ class NeighborhoodView(NamedTuple):
 # jitted window-buffer plumbing (module-level for jit cache reuse)
 
 
-@jax.jit
-def _append(buf, fill, key, nbr, val, ok):
-    """Scatter the chunk's valid entries to buffer slots [fill, fill+n).
-
-    Scatter (not a contiguous slab write) so only the valid entries need to
-    fit: invalid lanes are routed out of range and dropped.
-    """
-    bk, bn, bv, bo = buf
-    pos = fill + jnp.cumsum(ok.astype(jnp.int32)) - 1
-    idx = jnp.where(ok, pos, bk.shape[0])  # out-of-range => mode="drop"
-    bk = bk.at[idx].set(key, mode="drop")
-    bn = bn.at[idx].set(nbr, mode="drop")
-    bv = bv.at[idx].set(val, mode="drop")
-    bo = bo.at[idx].set(ok, mode="drop")
-    return (bk, bn, bv, bo), fill + jnp.sum(ok.astype(jnp.int32))
+def _assemble_buffer(parts, capacity: int, val_dtype, val_shape=()):
+    """Host-side window assembly: compact each chunk's valid entries with
+    numpy boolean indexing, pack into one padded buffer, and key-sort on
+    the host. One H2D per window instead of per-chunk device scatters plus
+    a device bitonic sort — numpy's radix argsort on ≤100k keys is ~20x
+    faster than the TPU sort at these sizes, and the sorted buffer uploads
+    once."""
+    bk = np.full((capacity,), segments.INT_MAX, np.int32)  # padding sorts last
+    bn = np.zeros((capacity,), np.int32)
+    bv = np.zeros((capacity,) + val_shape, np.dtype(val_dtype))
+    bo = np.zeros((capacity,), bool)
+    fill = 0
+    for c in parts:
+        m = np.asarray(c.valid)
+        k = np.asarray(c.src)[m]
+        fill2 = fill + k.shape[0]
+        bk[fill:fill2] = k
+        bn[fill:fill2] = np.asarray(c.dst)[m]
+        bv[fill:fill2] = np.asarray(c.val)[m]
+        bo[fill:fill2] = True
+        fill = fill2
+    order = np.argsort(bk[:fill], kind="stable")
+    bk[:fill] = bk[:fill][order]
+    bn[:fill] = bn[:fill][order]
+    bv[:fill] = bv[:fill][order]
+    return bk, bn, bv, bo
 
 
 @jax.jit
 def _sorted_view(buf) -> NeighborhoodView:
-    bk, bn, bv, bo = buf
-    sk, so, snbr, sval = segments.sort_by_key(bk, bo, bn, bv)
+    # Input is already key-sorted with padding keys = INT_MAX (host
+    # assembly); only the segment metadata is computed on device.
+    sk, snbr, sval, so = (jnp.asarray(x) for x in buf)
     starts = segments.segment_starts(sk, so)
     seg_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
     return NeighborhoodView(sk, snbr, sval, so, starts, seg_id)
-
-
-def _fresh_buffer(capacity: int, val_dtype, val_shape=()):
-    return (
-        jnp.zeros((capacity,), jnp.int32),
-        jnp.zeros((capacity,), jnp.int32),
-        jnp.zeros((capacity,) + val_shape, val_dtype),
-        jnp.zeros((capacity,), bool),
-    )
 
 
 class SnapshotStream:
@@ -188,32 +191,29 @@ class SnapshotStream:
 
         self.stats["late_edges"] = 0
         self.stats["windows_closed"] = 0
-        buf = None
-        fill = jnp.int32(0)
+        parts: list = []
         fill_host = 0
         cap = self.window_capacity
         for kind, w, chunk, n_valid in tumbling_window_events(
             self._transformed(), self.window_ms, self.stats
         ):
             if kind == "close":
-                yield w, _sorted_view(buf)
+                c0 = parts[0]
+                yield w, _sorted_view(_assemble_buffer(
+                    parts, cap, c0.val.dtype, c0.val.shape[1:]
+                ))
                 self.stats["windows_closed"] += 1
-                buf = None
-                fill = jnp.int32(0)
+                parts = []
                 fill_host = 0
                 continue
-            if buf is None:
-                if cap is None:
-                    cap = max(4 * chunk.capacity, 1024)
-                buf = _fresh_buffer(cap, chunk.val.dtype, chunk.val.shape[1:])
+            if cap is None:
+                cap = max(4 * chunk.capacity, 1024)
             if fill_host + n_valid > cap:
                 raise ValueError(
                     f"window buffer overflow (> {cap} edges in one "
                     f"window); raise window_capacity"
                 )
-            buf, fill = _append(
-                buf, fill, chunk.src, chunk.dst, chunk.val, chunk.valid
-            )
+            parts.append(chunk)
             fill_host += n_valid
 
     # -------------------------------------------------------------- #

@@ -23,6 +23,7 @@ only the intermediate granularity differs (documented deviation).
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Callable, Iterable, Iterator, NamedTuple
 
 import jax
@@ -64,6 +65,56 @@ class Update(NamedTuple):
         ids = ctx.decode(np.asarray(self.slots)[m])
         vals = np.asarray(self.values)[m]
         return list(zip(ids.tolist(), vals.tolist()))
+
+
+# ---------------------------------------------------------------------- #
+# module-level jitted steps (jax.jit caches by function identity: defining
+# these inside the iterator methods would recompile on every drain)
+
+
+@jax.jit
+def _vertices_step(seen, c: EdgeChunk):
+    n = seen.shape[0]
+    ids = jnp.concatenate([c.src, c.dst])
+    raw = jnp.concatenate([c.raw_src, c.raw_dst])
+    ok = jnp.concatenate([c.valid, c.valid])
+    first_in_chunk = segments.first_occurrence_mask(ids, ok, n)
+    new = first_in_chunk & ~seen[ids]
+    seen2 = segments.mark_seen(seen, ids, ok)
+    return seen2, Update(ids, raw, new)
+
+
+@jax.jit
+def _edge_count_step(total, c: EdgeChunk):
+    delta = jnp.where(c.event == 1, -1, 1)
+    return total + jnp.sum(jnp.where(c.valid, delta, 0))
+
+
+@jax.jit
+def _vertex_count_step(seen, c: EdgeChunk):
+    ids = jnp.concatenate([c.src, c.dst])
+    ok = jnp.concatenate([c.valid, c.valid])
+    seen2 = segments.mark_seen(seen, ids, ok)
+    return seen2, jnp.sum(seen2.astype(jnp.int64))
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _pair_keys(c: EdgeChunk, cap: int):
+    return c.src.astype(jnp.int64) * jnp.int64(cap) + c.dst.astype(jnp.int64)
+
+
+@partial(jax.jit, static_argnames=("count_out", "count_in"))
+def _degree_step(deg, c: EdgeChunk, count_out: bool, count_in: bool):
+    n = deg.shape[0]
+    delta = jnp.where(c.event == 1, -1, 1).astype(jnp.int64)
+    if count_out:
+        deg = segments.masked_scatter_add(deg, c.src, delta, c.valid)
+    if count_in:
+        deg = segments.masked_scatter_add(deg, c.dst, delta, c.valid)
+    ids = jnp.concatenate([c.src, c.dst])
+    ok = jnp.concatenate([c.valid & count_out, c.valid & count_in])
+    touched = segments.first_occurrence_mask(ids, ok, n)
+    return deg, Update(ids, deg[ids], touched)
 
 
 class EdgeStream:
@@ -167,14 +218,10 @@ class EdgeStream:
         src_fn = self._chunks_fn
         cap = self.ctx.vertex_capacity
 
-        @jax.jit
-        def keys_of(c: EdgeChunk):
-            return c.src.astype(jnp.int64) * jnp.int64(cap) + c.dst.astype(jnp.int64)
-
         def gen():
             hset = DeviceHashSet()
             for c in src_fn():
-                is_new = hset.insert(keys_of(c), c.valid)
+                is_new = hset.insert(_pair_keys(c, cap), c.valid)
                 yield c.mask(is_new)
 
         return EdgeStream(gen, self.ctx)
@@ -188,20 +235,10 @@ class EdgeStream:
         the vertices never seen before."""
         n = self.ctx.vertex_capacity
 
-        @jax.jit
-        def step(seen, c: EdgeChunk):
-            ids = jnp.concatenate([c.src, c.dst])
-            raw = jnp.concatenate([c.raw_src, c.raw_dst])
-            ok = jnp.concatenate([c.valid, c.valid])
-            first_in_chunk = segments.first_occurrence_mask(ids, ok, n)
-            new = first_in_chunk & ~seen[ids]
-            seen2 = segments.mark_seen(seen, ids, ok)
-            return seen2, Update(ids, raw, new)
-
         def gen():
             seen = jnp.zeros((n,), bool)
             for c in self._chunks_fn():
-                seen, upd = step(seen, c)
+                seen, upd = _vertices_step(seen, c)
                 yield upd
 
         return gen()
@@ -226,15 +263,10 @@ class EdgeStream:
         events count -1 so the total tracks the live graph, consistent with
         DegreeStream."""
 
-        @jax.jit
-        def step(total, c: EdgeChunk):
-            delta = jnp.where(c.event == 1, -1, 1)
-            return total + jnp.sum(jnp.where(c.valid, delta, 0))
-
         def gen():
             total = jnp.zeros((), jnp.int64)
             for c in self._chunks_fn():
-                total = step(total, c)
+                total = _edge_count_step(total, c)
                 yield int(total)
 
         return gen()
@@ -246,18 +278,11 @@ class EdgeStream:
 
         n = self.ctx.vertex_capacity
 
-        @jax.jit
-        def step(seen, c: EdgeChunk):
-            ids = jnp.concatenate([c.src, c.dst])
-            ok = jnp.concatenate([c.valid, c.valid])
-            seen2 = segments.mark_seen(seen, ids, ok)
-            return seen2, jnp.sum(seen2.astype(jnp.int64))
-
         def gen():
             seen = jnp.zeros((n,), bool)
             last = -1
             for c in self._chunks_fn():
-                seen, count = step(seen, c)
+                seen, count = _vertex_count_step(seen, c)
                 count = int(count)
                 if count != last:  # emit-on-change dedup (GlobalAggregateMapper)
                     last = count
@@ -332,25 +357,9 @@ class DegreeStream:
 
     def __iter__(self) -> Iterator[Update]:
         n = self.stream.ctx.vertex_capacity
-        count_out, count_in = self.count_out, self.count_in
-
-        @jax.jit
-        def step(deg, c: EdgeChunk):
-            delta = jnp.where(c.event == 1, -1, 1).astype(jnp.int64)
-            if count_out:
-                deg = segments.masked_scatter_add(deg, c.src, delta, c.valid)
-            if count_in:
-                deg = segments.masked_scatter_add(deg, c.dst, delta, c.valid)
-            ids = jnp.concatenate([c.src, c.dst])
-            ok = jnp.concatenate(
-                [c.valid & count_out, c.valid & count_in]
-            )
-            touched = segments.first_occurrence_mask(ids, ok, n)
-            return deg, Update(ids, deg[ids], touched)
-
         deg = jnp.zeros((n,), jnp.int64)
         for c in self.stream:
-            deg, upd = step(deg, c)
+            deg, upd = _degree_step(deg, c, self.count_out, self.count_in)
             yield upd
 
     def final_degrees(self) -> dict[int, int]:

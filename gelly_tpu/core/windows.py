@@ -59,7 +59,10 @@ def tumbling_window_events(
                     dirty = False
                 current = w
             mask = ok & (tw == w)
-            yield ("edges", w, c.mask(jnp.asarray(mask)), int(mask.sum()))
+            # Host chunks stay host (an np mask keeps valid numpy); device
+            # chunks get a device mask to avoid an implicit H2D per op.
+            m = mask if c.is_host() else jnp.asarray(mask)
+            yield ("edges", w, c.mask(m), int(mask.sum()))
             dirty = True
     if dirty:
         yield ("close", current, None, 0)

@@ -157,41 +157,52 @@ def _compiled_plan(agg: SummaryAggregation, m):
             ),
         )
 
-    @partial(jax.jit, out_shardings=sharded)
-    def fold_step(locals_, chunk_split):
-        def body(loc, ck):
-            s = unshard_leaf(loc)
-            c = EdgeChunk(*(x[0] for x in ck))
-            return shard_leaf(agg.fold(s, c))
+    if S == 1:
+        # Single-shard specialization: the shard_map + collective plumbing
+        # is identity at S=1 and only adds dispatch/layout overhead.
+        def locals0_fn():  # noqa: F811
+            return jax.device_put(agg.init())
 
-        return mesh_lib.shard_map_fn(
-            m, body, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-            out_specs=P(SHARD_AXIS),
-        )(locals_, chunk_split)
+        fold_step = jax.jit(agg.fold)
+        merge_locals = jax.jit(lambda s: s)
+    else:
+        @partial(jax.jit, out_shardings=sharded)
+        def fold_step(locals_, chunk):
+            # Split fused into the same program as the fold: one dispatch
+            # per chunk (dispatch round-trips dominate on a tunneled device).
+            chunk_split = partition.split_chunk(chunk, S)
 
-    @jax.jit
-    def merge_locals(locals_):
-        def body(loc):
-            s = unshard_leaf(loc)
-            if agg.merge_stacked is not None:
-                g = collectives.gather_merge(agg.merge_stacked, s)
-            else:
-                g = collectives.butterfly_merge(agg.combine, s, S)
-            return shard_leaf(g)
+            def body(loc, ck):
+                s = unshard_leaf(loc)
+                c = EdgeChunk(*(x[0] for x in ck))
+                return shard_leaf(agg.fold(s, c))
 
-        merged = mesh_lib.shard_map_fn(
-            m, body, in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS),
-        )(locals_)
-        # All shards hold the identical global merge; take shard 0.
-        return unshard_leaf(merged)
+            return mesh_lib.shard_map_fn(
+                m, body, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                out_specs=P(SHARD_AXIS),
+            )(locals_, chunk_split)
+
+        @jax.jit
+        def merge_locals(locals_):
+            def body(loc):
+                s = unshard_leaf(loc)
+                if agg.merge_stacked is not None:
+                    g = collectives.gather_merge(agg.merge_stacked, s)
+                else:
+                    g = collectives.butterfly_merge(agg.combine, s, S)
+                return shard_leaf(g)
+
+            merged = mesh_lib.shard_map_fn(
+                m, body, in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS),
+            )(locals_)
+            # All shards hold the identical global merge; take shard 0.
+            return unshard_leaf(merged)
 
     @jax.jit
     def merger_step(window_summary, global_summary):
         # The parallelism-1 Merger (M/SummaryAggregation.java:107-119):
         # incremental non-blocking global combine.
         return agg.combine(window_summary, global_summary)
-
-    split = jax.jit(partial(partition.split_chunk, num_shards=S))
 
     # transform runs jitted by default: an eager lax.while_loop (e.g. the CC
     # label pointer-jump) re-dispatches per call and dominates the window
@@ -203,7 +214,7 @@ def _compiled_plan(agg: SummaryAggregation, m):
     else:
         transform_fn = agg.transform
 
-    plan = (fold_step, merge_locals, merger_step, split, locals0_fn,
+    plan = (fold_step, merge_locals, merger_step, locals0_fn,
             transform_fn)
     per_agg[key] = plan
     return plan
@@ -219,6 +230,7 @@ def run_aggregation(
     checkpoint_every: int = 1,
     resume: bool = False,
     prefetch_depth: int = 2,
+    device_fields: tuple[str, ...] | None = None,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
 
@@ -228,6 +240,11 @@ def run_aggregation(
 
     ``prefetch_depth`` chunks of host ingest (parse/densify/H2D) overlap
     device folds on a background thread; 0 disables.
+
+    ``device_fields`` names chunk fields to device_put on the prefetch
+    thread (e.g. ``("src", "dst", "valid")`` for CC): the H2D of exactly
+    the fields the fold reads then overlaps compute, while unused fields
+    stay host-side (jit prunes dead args, so they are never transferred).
 
     ``checkpoint_path`` snapshots the global summary + stream position every
     ``checkpoint_every`` closed windows (the Merger's ListCheckpointed analog,
@@ -241,7 +258,7 @@ def run_aggregation(
 
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     plan = _compiled_plan(agg, m)
-    (fold_step, merge_locals, merger_step, split, locals0_fn,
+    (fold_step, merge_locals, merger_step, locals0_fn,
      transform_fn) = plan
     locals0 = locals0_fn()
 
@@ -318,9 +335,25 @@ def run_aggregation(
 
         from ..utils.prefetch import prefetch
 
+        def stage(c):
+            # Window mode needs ts/valid host-side (the tumbling iterator
+            # reads them per chunk); skip pre-staging there.
+            if device_fields and window_ms is None:
+                return c._replace(**{
+                    f: jax.device_put(getattr(c, f)) for f in device_fields
+                })
+            return c
+
         def counted_chunks():
+            # Chunks stay host-side through the prefetch queue: jit prunes
+            # dead arguments at dispatch, so only the fields the fold
+            # actually reads are transferred (an explicit full device_put
+            # would upload all 8 — ~3x the bytes on a bandwidth-limited
+            # link), and the tumbling iterator reads ts/valid on the host.
+            # device_fields moves exactly the hot fields' H2D onto the
+            # prefetch thread to overlap the folds.
             nonlocal chunks_consumed
-            for chunk in prefetch(iter(stream), prefetch_depth):
+            for chunk in prefetch(map(stage, iter(stream)), prefetch_depth):
                 # In window mode checkpoints fire only here, at chunk
                 # boundaries: every edge of the chunks counted so far is in
                 # locals_ or global_summary, so the recorded position is
@@ -348,7 +381,7 @@ def run_aggregation(
                     yield close_window()
                 else:
                     current_window = w
-                    locals_ = fold_step(locals_, split(chunk))
+                    locals_ = fold_step(locals_, chunk)
                     dirty = True
             # The iterator closes the final partial window itself; just make
             # sure the last state is durably checkpointed.
@@ -356,7 +389,7 @@ def run_aggregation(
                 maybe_checkpoint(force=True)
         else:
             for chunk in counted_chunks():
-                locals_ = fold_step(locals_, split(chunk))
+                locals_ = fold_step(locals_, chunk)
                 chunks_in_window += 1
                 dirty = True
                 if chunks_in_window >= merge_every:

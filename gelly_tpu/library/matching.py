@@ -63,32 +63,82 @@ def _matching_step(state: MatchingState, chunk) -> MatchingState:
     return out
 
 
+def _matching_step_host(state: MatchingState, chunk) -> MatchingState:
+    """Host per-edge loop over the chunk's valid edges — the default path.
+
+    The stage is a strictly-sequential scalar state machine (the reference
+    runs it as one parallelism-1 operator, CentralizedWeightedMatching.java
+    :59-60); a device lax.scan pays per-step scatter latency for ~10 scalar
+    ops of real work, so the host loop is ~100x faster. The device variant
+    remains available (device=True) for pipelines that must stay resident.
+    """
+    partner = np.asarray(state.partner).copy()
+    weight = np.asarray(state.weight).copy()
+    m = np.asarray(chunk.valid)
+    for u, v, w in zip(
+        np.asarray(chunk.src)[m].tolist(),
+        np.asarray(chunk.dst)[m].tolist(),
+        np.asarray(chunk.val)[m].tolist(),
+    ):
+        if u == v:
+            continue
+        pu, pv = int(partner[u]), int(partner[v])
+        if pu == v and pv == u:
+            coll_sum = weight[u]
+        else:
+            coll_sum = (weight[u] if pu >= 0 else 0.0) + (
+                weight[v] if pv >= 0 else 0.0
+            )
+        if w > 2.0 * coll_sum:
+            for x, px in ((u, pu), (v, pv)):
+                if px >= 0:
+                    partner[px] = -1
+                    weight[px] = 0.0
+                    partner[x] = -1
+                    weight[x] = 0.0
+            partner[u], partner[v] = v, u
+            weight[u] = weight[v] = w
+    return MatchingState(partner, weight)
+
+
 class WeightedMatchingStream:
     """Iterate for per-chunk states; ``final_matching`` returns the matched
     raw-id edge set and ``total_weight`` its weight."""
 
-    def __init__(self, stream):
+    def __init__(self, stream, device: bool = False):
         self.stream = stream
+        self.device = device
 
     def __iter__(self) -> Iterator[MatchingState]:
         n = self.stream.ctx.vertex_capacity
+        if self.device:
+            state = MatchingState(
+                partner=jnp.full((n,), -1, jnp.int32),
+                weight=jnp.zeros((n,), jnp.float32),
+            )
+            for c in self.stream:
+                state = _matching_step(state, c)
+                yield state
+            return
         state = MatchingState(
-            partner=jnp.full((n,), -1, jnp.int32),
-            weight=jnp.zeros((n,), jnp.float32),
+            partner=np.full((n,), -1, np.int32),
+            weight=np.zeros((n,), np.float32),
         )
         for c in self.stream:
-            state = _matching_step(state, c)
+            state = _matching_step_host(state, c)
             yield state
 
     def final(self) -> MatchingState:
         if not getattr(self, "_drained", False):
-            n = self.stream.ctx.vertex_capacity
-            state = MatchingState(
-                partner=jnp.full((n,), -1, jnp.int32),
-                weight=jnp.zeros((n,), jnp.float32),
-            )  # empty-stream result
+            state = None
             for state in self:
                 pass
+            if state is None:  # empty stream
+                n = self.stream.ctx.vertex_capacity
+                state = MatchingState(
+                    partner=np.full((n,), -1, np.int32),
+                    weight=np.zeros((n,), np.float32),
+                )
             self._final = state
             self._drained = True
         return self._final
@@ -110,5 +160,5 @@ class WeightedMatchingStream:
         return sum(w for _, _, w in self.final_matching())
 
 
-def weighted_matching(stream) -> WeightedMatchingStream:
-    return WeightedMatchingStream(stream)
+def weighted_matching(stream, device: bool = False) -> WeightedMatchingStream:
+    return WeightedMatchingStream(stream, device=device)

@@ -43,14 +43,20 @@ from ..ops import segments
 # windowed
 
 
-@partial(jax.jit, static_argnames=("capacity",))
-def _window_triangle_count(view: NeighborhoodView, capacity: int) -> jax.Array:
+@partial(jax.jit, static_argnames=("capacity", "method"))
+def _window_triangle_count(view: NeighborhoodView, capacity: int,
+                           method: str = "gather") -> jax.Array:
     """Triangles inside one window's (ALL-direction) sorted view.
 
     Counts, per unique canonical window edge (a, b), the wedge centers u
     adjacent to both with u < a and u < b — the candidate/match semantics of
     GenerateCandidateEdges + CountTriangles (WindowTriangles.java:82-139):
     each triangle contributes exactly one candidate from its minimum vertex.
+
+    ``method="gather"`` walks per-edge column pairs on the VPU (O(N·E));
+    ``method="mxu"``/``"mxu_interpret"`` computes the full wedge matrix
+    W = MᵀM with the Pallas MXU kernel (O(N³) but at systolic-array rate —
+    the win for dense windows, E ≳ N).
     """
     n = capacity
     key = jnp.where(view.valid, view.key, 0)
@@ -62,8 +68,14 @@ def _window_triangle_count(view: NeighborhoodView, capacity: int) -> jax.Array:
     # unique canonical edges (a < b), one per undirected window edge
     canon = view.valid & (view.key < view.nbr)
     uniq = segments.unique_pairs_mask(view.key, view.nbr, canon, n)
-    # per-edge common smaller-neighbor count: dot of M columns a and b
-    per_edge = jnp.sum(m[:, view.key] & m[:, view.nbr], axis=0)
+    if method.startswith("mxu"):
+        from ..ops.pallas_kernels import wedge_count_matrix
+
+        w = wedge_count_matrix(m, interpret=method == "mxu_interpret")
+        per_edge = w[view.key, view.nbr].astype(jnp.int32)
+    else:
+        # per-edge common smaller-neighbor count: dot of M columns a and b
+        per_edge = jnp.sum(m[:, view.key] & m[:, view.nbr], axis=0)
     return jnp.sum(jnp.where(uniq, per_edge, 0))
 
 
@@ -82,14 +94,14 @@ def _check_slot_range(capacity: int, full_capacity: int, *arrays_with_mask):
             )
 
 
-def window_triangles(stream, window_ms: int, capacity: int | None = None,
-                     window_capacity: int | None = None) -> Iterator[tuple]:
-    """Per-window triangle counts: yields (window_index, count).
-
-    The reference emits (count, window.maxTimestamp) per window
-    (WindowTriangles.java:61-65); window_index * window_ms + window_ms - 1
-    recovers that timestamp.
-    """
+def window_triangle_counts_device(stream, window_ms: int,
+                                  capacity: int | None = None,
+                                  window_capacity: int | None = None,
+                                  method: str = "auto") -> Iterator[tuple]:
+    """Like :func:`window_triangles` but yields (window, device_scalar)
+    WITHOUT host synchronization — counts stay on device so windows
+    pipeline. Batch-pull at the end (one D2H round-trip instead of one per
+    window; on a tunneled TPU a sync costs ~100ms of fixed latency)."""
     n = capacity if capacity is not None else stream.ctx.vertex_capacity
     snap = stream.slice(window_ms, "all", window_capacity=window_capacity)
     for w, view in snap.views():
@@ -97,7 +109,32 @@ def window_triangles(stream, window_ms: int, capacity: int | None = None,
             n, stream.ctx.vertex_capacity,
             (view.key, view.valid), (view.nbr, view.valid),
         )
-        yield w, int(_window_triangle_count(view, n))
+        m = method
+        if m == "auto":
+            from ..ops.pallas_kernels import on_tpu
+
+            dense = view.key.shape[0] >= n and n % 128 == 0
+            m = "mxu" if (dense and on_tpu()) else "gather"
+        yield w, _window_triangle_count(view, n, m)
+
+
+def window_triangles(stream, window_ms: int, capacity: int | None = None,
+                     window_capacity: int | None = None,
+                     method: str = "auto") -> Iterator[tuple]:
+    """Per-window triangle counts: yields (window_index, count).
+
+    The reference emits (count, window.maxTimestamp) per window
+    (WindowTriangles.java:61-65); window_index * window_ms + window_ms - 1
+    recovers that timestamp.
+
+    ``method``: "gather" (VPU, sparse windows), "mxu" (Pallas matmul, dense
+    windows; needs capacity % 128 == 0), or "auto" (mxu on TPU when the
+    window buffer is dense relative to capacity).
+    """
+    for w, c in window_triangle_counts_device(
+        stream, window_ms, capacity, window_capacity, method
+    ):
+        yield w, int(c)
 
 
 # --------------------------------------------------------------------- #
@@ -169,14 +206,16 @@ class ExactTriangleStream:
 
     def final(self) -> TriangleCounts:
         if not getattr(self, "_drained", False):
-            n = self.capacity
-            state = TriangleCounts(
-                adj=jnp.zeros((n, n), bool),
-                counts=jnp.zeros((n,), jnp.int64),
-                total=jnp.zeros((), jnp.int64),
-            )  # empty-stream result
+            state = None
             for state in self:
                 pass
+            if state is None:  # empty stream: allocate the zero state lazily
+                n = self.capacity
+                state = TriangleCounts(
+                    adj=jnp.zeros((n, n), bool),
+                    counts=jnp.zeros((n,), jnp.int64),
+                    total=jnp.zeros((), jnp.int64),
+                )
             self._final = state
             self._drained = True
         return self._final

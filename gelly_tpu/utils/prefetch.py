@@ -28,27 +28,53 @@ class _Error:
 
 
 def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
-    """Iterate ``it`` on a background thread, ``depth`` items ahead."""
+    """Iterate ``it`` on a background thread, ``depth`` items ahead.
+
+    Cancellation-safe: abandoning the returned generator (break /
+    GeneratorExit / GC) signals the worker, which stops pulling from the
+    source and exits instead of blocking forever on the full queue.
+    """
     if depth <= 0:
         yield from it
         return
     q: "queue.Queue" = queue.Queue(maxsize=depth)
+    cancel = threading.Event()
 
     def worker():
         try:
             for item in it:
-                q.put(item)
+                while not cancel.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if cancel.is_set():
+                    return
         except BaseException as e:  # re-raised at the consumer
-            q.put(_Error(e))
+            if not cancel.is_set():
+                q.put(_Error(e))
         finally:
-            q.put(_DONE)
+            # Blocking put with cancel checks: the queue may be full, and
+            # the consumer needs _DONE to terminate — but must not deadlock
+            # if the consumer is gone (cancel set).
+            while True:
+                try:
+                    q.put(_DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    if cancel.is_set():
+                        break
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _DONE:
-            return
-        if isinstance(item, _Error):
-            raise item.exc
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, _Error):
+                raise item.exc
+            yield item
+    finally:
+        cancel.set()

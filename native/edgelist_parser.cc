@@ -48,6 +48,12 @@ struct Buf {
   }
 };
 
+inline bool at_token_end(const char* p, const char* end) {
+  // A numeric token must terminate at whitespace/EOL/EOF — '2x' is not an
+  // id (python-parser parity: int("2x") raises and the line is skipped).
+  return p >= end || *p == ' ' || *p == '\t' || *p == '\r' || *p == '\n';
+}
+
 inline const char* skip_ws(const char* p, const char* end) {
   while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
   return p;
@@ -87,13 +93,14 @@ int parse_edge_list(const char* path, int64_t** src_out, int64_t** dst_out,
   fseek(f, 0, SEEK_END);
   long fsize = ftell(f);
   fseek(f, 0, SEEK_SET);
-  char* text = static_cast<char*>(malloc(fsize ? fsize : 1));
+  char* text = static_cast<char*>(malloc(fsize + 1));
   if (!text) {
     fclose(f);
     return 2;
   }
   size_t got = fread(text, 1, fsize, f);
   fclose(f);
+  text[got] = '\0';  // strtod guard: parsing never runs past the buffer
 
   Buf src, dst, val;
   const char* p = text;
@@ -112,13 +119,13 @@ int parse_edge_list(const char* path, int64_t** src_out, int64_t** dst_out,
     }
     int64_t a, b;
     const char* q = parse_i64(p, end, &a);
-    if (!q) {
+    if (!q || !at_token_end(q, end)) {
       p = skip_line(p, end);  // malformed line: skip (parser parity with
       continue;               // the examples' lenient split-and-parse)
     }
     q = skip_ws(q, end);
     q = parse_i64(q, end, &b);
-    if (!q) {
+    if (!q || !at_token_end(q, end)) {
       p = skip_line(p, end);
       continue;
     }
@@ -128,25 +135,19 @@ int parse_edge_list(const char* path, int64_t** src_out, int64_t** dst_out,
     }
     if (want_vals) {
       q = skip_ws(q, end);
-      int64_t iv;
       double v = 1.0;
-      // Accept integer or simple decimal third column; default 1.0. Sign
-      // is tracked independently of the integer part so "-0.5" keeps it.
-      bool vneg = (q < end && *q == '-');
-      const char* r = parse_i64(q, end, &iv);
-      if (r != nullptr) {
-        double mag = static_cast<double>(iv < 0 ? -iv : iv);
-        if (r < end && *r == '.') {
-          ++r;
-          double frac = 0, scale = 1;
-          while (r < end && *r >= '0' && *r <= '9') {
-            frac = frac * 10 + (*r - '0');
-            scale *= 10;
-            ++r;
-          }
-          mag += frac / scale;
+      // Full float grammar via strtod (exponents, leading dot, sign) —
+      // python-parser parity: float(fields[2]), defaulting to 1.0 when the
+      // column is missing or malformed. The buffer is NUL-terminated and
+      // strtod stops at the first invalid char, so it cannot run past a
+      // line boundary (newlines terminate parsing).
+      if (q < end && *q != '\n') {
+        char* vend = nullptr;
+        double parsed = strtod(q, &vend);
+        if (vend != q && at_token_end(vend, end)) {
+          v = parsed;
+          q = vend;
         }
-        v = vneg ? -mag : mag;
       }
       if (!val.push_f64(v)) {
         rc = 2;

@@ -161,3 +161,22 @@ def test_iterative_cc_transitive_across_chunks():
     assert labels[slot[9]] == labels[slot[1]] == labels[slot[5]]
     assert labels[slot[8]] == labels[slot[0]] == labels[slot[7]]
     assert labels[slot[9]] != labels[slot[8]]
+
+
+def test_matching_device_path_matches_host():
+    rng = np.random.default_rng(12)
+    edges = [
+        (int(a), int(b), float(w))
+        for (a, b), w in zip(
+            rng.integers(0, 16, (40, 2)), rng.integers(1, 100, 40)
+        )
+        if a != b
+    ]
+    host = weighted_matching(
+        edge_stream_from_edges(edges, vertex_capacity=32, chunk_size=8)
+    ).final_matching()
+    dev = weighted_matching(
+        edge_stream_from_edges(edges, vertex_capacity=32, chunk_size=8),
+        device=True,
+    ).final_matching()
+    assert host == dev

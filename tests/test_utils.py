@@ -104,3 +104,38 @@ def test_aggregation_with_prefetch_matches(reference_edges):
         agg = connected_components(32)
         labels = s.aggregate(agg, merge_every=2, prefetch_depth=depth).result()
         assert labels_to_components(labels, s.ctx) == expected, depth
+
+
+@pytest.mark.skipif(not _native_available(), reason="no native toolchain")
+def test_native_parser_float_grammar_and_garbage(tmp_path):
+    from gelly_tpu.core.io import parse_edge_list_text
+    from gelly_tpu.utils.native import parse_edge_list_file
+
+    p = tmp_path / "edges.txt"
+    p.write_text("1 2 1e3\n3 4 .5\n5 6 -0.25\n7 8x\n9 10 2.5e-2\n11 12\n")
+    ns, nd, nv = parse_edge_list_file(str(p), want_vals=True)
+    ps, pd, pv = parse_edge_list_text(p.read_text(), num_value_cols=1)
+    np.testing.assert_array_equal(ns, ps)
+    np.testing.assert_array_equal(nd, pd)
+    np.testing.assert_allclose(nv, pv)
+    assert nv.tolist() == [1000.0, 0.5, -0.25, 0.025, 1.0]
+
+
+def test_prefetch_early_abandon_unblocks_worker():
+    import threading
+    import time as _t
+
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 0
+    it.close()  # abandon mid-stream
+    _t.sleep(0.4)  # worker should notice the cancel and exit
+    assert threading.active_count() <= before + 1
+    assert len(produced) < 20  # source was not fully drained
