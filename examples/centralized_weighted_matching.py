@@ -21,8 +21,8 @@ def main(args):
     stream = stream_from_args(args, default_edges=DEFAULT, num_value_cols=1)
     t0 = time.perf_counter()
     wm = weighted_matching(stream)
-    for a, b, w in wm.final_matching():
-        print(f"ADD ({a},{b},{w})")
+    for ev in wm.events():  # the reference's MatchingEvent print stream
+        print(f"{ev.type} ({ev.src},{ev.dst},{ev.weight})")
     print(f"total weight: {wm.total_weight()}")
     print(f"Runtime: {int((time.perf_counter() - t0) * 1000)} ms")
 

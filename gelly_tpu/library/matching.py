@@ -24,6 +24,16 @@ class MatchingState(NamedTuple):
     weight: jax.Array  # f32[N] weight of the matched edge at this endpoint
 
 
+class MatchingEvent(NamedTuple):
+    """ADD/REMOVE event — the reference's observable output
+    (M/util/MatchingEvent.java:24-42)."""
+
+    type: str  # "ADD" | "REMOVE"
+    src: int  # raw vertex ids
+    dst: int
+    weight: float
+
+
 @jax.jit
 def _matching_step(state: MatchingState, chunk) -> MatchingState:
     def step(s, inp):
@@ -63,7 +73,8 @@ def _matching_step(state: MatchingState, chunk) -> MatchingState:
     return out
 
 
-def _matching_step_host(state: MatchingState, chunk) -> MatchingState:
+def _matching_step_host(state: MatchingState, chunk,
+                        events: list | None = None) -> MatchingState:
     """Host per-edge loop over the chunk's valid edges — the default path.
 
     The stage is a strictly-sequential scalar state machine (the reference
@@ -83,21 +94,29 @@ def _matching_step_host(state: MatchingState, chunk) -> MatchingState:
         if u == v:
             continue
         pu, pv = int(partner[u]), int(partner[v])
-        if pu == v and pv == u:
+        same = pu == v and pv == u  # colliding edge is (u, v) itself
+        if same:
             coll_sum = weight[u]
         else:
             coll_sum = (weight[u] if pu >= 0 else 0.0) + (
                 weight[v] if pv >= 0 else 0.0
             )
         if w > 2.0 * coll_sum:
-            for x, px in ((u, pu), (v, pv)):
+            evict = ((u, pu),) if same else ((u, pu), (v, pv))
+            for x, px in evict:
                 if px >= 0:
+                    if events is not None:
+                        events.append(MatchingEvent(
+                            "REMOVE", x, px, float(weight[x])
+                        ))
                     partner[px] = -1
                     weight[px] = 0.0
                     partner[x] = -1
                     weight[x] = 0.0
             partner[u], partner[v] = v, u
             weight[u] = weight[v] = w
+            if events is not None:
+                events.append(MatchingEvent("ADD", u, v, float(w)))
     return MatchingState(partner, weight)
 
 
@@ -127,6 +146,27 @@ class WeightedMatchingStream:
         for c in self.stream:
             state = _matching_step_host(state, c)
             yield state
+
+    def events(self) -> Iterator[MatchingEvent]:
+        """ADD/REMOVE event stream with raw vertex ids — the reference's
+        collector output (WeightedMatchingFlatMapper, ADD at :103-104,
+        REMOVE at :99-101). Host path only."""
+        ctx = self.stream.ctx
+        n = ctx.vertex_capacity
+        state = MatchingState(
+            partner=np.full((n,), -1, np.int32),
+            weight=np.zeros((n,), np.float32),
+        )
+        for c in self.stream:
+            evs: list = []
+            state = _matching_step_host(state, c, evs)
+            for e in evs:
+                a, b = ctx.decode(np.array([e.src, e.dst])).tolist()
+                yield MatchingEvent(e.type, a, b, e.weight)
+        # A full drain just happened: cache it so final()/total_weight()
+        # don't recompute the whole stream.
+        self._final = state
+        self._drained = True
 
     def final(self) -> MatchingState:
         if not getattr(self, "_drained", False):

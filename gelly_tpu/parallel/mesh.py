@@ -22,6 +22,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 SHARD_AXIS = "shards"
 
 
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> None:
+    """Join a multi-host mesh over DCN (jax.distributed).
+
+    After initialization, ``jax.devices()`` spans every host's chips and
+    :func:`make_mesh` builds one global shard axis across them — ICI within
+    a slice, DCN between hosts. This is the analog of the reference's
+    multi-TaskManager deployment (SURVEY.md §2.9: its inter-host transport
+    is Flink's Netty shuffle; here it is XLA collectives over DCN). Under a
+    standard TPU pod launcher the arguments auto-detect (pass nothing).
+    """
+    kw = {}
+    if coordinator_address is not None:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(**kw)
+
+
 def make_mesh(num_shards: int | None = None, devices=None) -> Mesh:
     """A 1-D mesh over ``num_shards`` devices (default: all available)."""
     devs = list(devices if devices is not None else jax.devices())

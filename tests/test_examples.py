@@ -180,3 +180,33 @@ def test_matching_device_path_matches_host():
         device=True,
     ).final_matching()
     assert host == dev
+
+
+def test_matching_event_stream():
+    edges = [(1, 2, 10.0), (3, 4, 10.0), (2, 3, 45.0)]
+    s = edge_stream_from_edges(edges, vertex_capacity=8, chunk_size=1)
+    evs = list(weighted_matching(s).events())
+    kinds = [(e.type, frozenset((e.src, e.dst))) for e in evs]
+    assert kinds == [
+        ("ADD", frozenset({1, 2})),
+        ("ADD", frozenset({3, 4})),
+        ("REMOVE", frozenset({1, 2})),
+        ("REMOVE", frozenset({3, 4})),
+        ("ADD", frozenset({2, 3})),
+    ]
+
+
+def test_matching_same_edge_rematch_single_remove():
+    # Evicting the edge (u,v) itself must emit exactly one REMOVE.
+    s = edge_stream_from_edges([(1, 2, 10.0), (1, 2, 45.0)],
+                               vertex_capacity=8, chunk_size=1)
+    wm = weighted_matching(s)
+    evs = [(e.type, frozenset((e.src, e.dst)), e.weight)
+           for e in wm.events()]
+    assert evs == [
+        ("ADD", frozenset({1, 2}), 10.0),
+        ("REMOVE", frozenset({1, 2}), 10.0),
+        ("ADD", frozenset({1, 2}), 45.0),
+    ]
+    # events() drain is cached: total_weight must not recompute.
+    assert wm.total_weight() == 45.0
