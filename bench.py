@@ -49,19 +49,23 @@ def baseline_cc(src: np.ndarray, dst: np.ndarray) -> tuple[dict, float]:
             parent[x], x = root, parent[x]
         return root
 
-    t0 = time.perf_counter()
-    for u, v in zip(src.tolist(), dst.tolist()):
-        if u not in parent:
-            parent[u] = u
-        if v not in parent:
-            parent[v] = v
-        ru, rv = find(u), find(v)
-        if ru != rv:
-            if ru < rv:
-                parent[rv] = ru
-            else:
-                parent[ru] = rv
-    dt = time.perf_counter() - t0
+    # Best of 2, symmetric with the accelerator side's repeat policy.
+    dt = float("inf")
+    for _ in range(2):
+        parent.clear()
+        t0 = time.perf_counter()
+        for u, v in zip(src.tolist(), dst.tolist()):
+            if u not in parent:
+                parent[u] = u
+            if v not in parent:
+                parent[v] = v
+            ru, rv = find(u), find(v)
+            if ru != rv:
+                if ru < rv:
+                    parent[rv] = ru
+                else:
+                    parent[ru] = rv
+        dt = min(dt, time.perf_counter() - t0)
     labels = {x: find(x) for x in parent}
     return labels, dt
 
@@ -92,13 +96,18 @@ def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int):
     warm_stream = edge_stream_from_source(warm, num_vertices)
     warm_stream.aggregate(agg, merge_every=merge_every).result()
 
-    stream = make_stream()
-    t0 = time.perf_counter()
-    labels = stream.aggregate(
-        agg, merge_every=merge_every, device_fields=("src", "dst", "valid")
-    ).result()
-    labels = np.asarray(labels)  # real completion barrier (D2H pull)
-    dt = time.perf_counter() - t0
+    # Best of 2 timed passes: the timed region ends in a real D2H pull
+    # (completion barrier), and the repeat damps transient load on the
+    # shared device link.
+    dt = float("inf")
+    for _ in range(2):
+        stream = make_stream()
+        t0 = time.perf_counter()
+        labels = stream.aggregate(
+            agg, merge_every=merge_every, device_fields=("src", "dst", "valid")
+        ).result()
+        labels = np.asarray(labels)  # real completion barrier (D2H pull)
+        dt = min(dt, time.perf_counter() - t0)
     return labels, stream.ctx, dt
 
 

@@ -252,14 +252,18 @@ class SnapshotStream:
                        ) -> Iterator[WindowUpdate]:
         """Per-vertex sequential fold ``fold_fn(acc, v, nbr, val)`` per window
         (SnapshotStream.foldNeighbors, M/SnapshotStream.java:61-86). Exact
-        fold-order parity via a segmented lax.scan over the sorted buffer."""
-        init = jnp.asarray(initial_value)
+        fold-order parity via a segmented lax.scan over the sorted buffer.
+        ``initial_value`` may be any pytree (the reference folds into TupleN
+        accumulators, e.g. TestSlice's Tuple2 SumEdgeValues)."""
+        init = jax.tree.map(jnp.asarray, initial_value)
 
         @jax.jit
         def close(view: NeighborhoodView):
             def step(acc, inp):
                 key, nbr, val, ok, start = inp
-                acc = jnp.where(start, init, acc)
+                acc = jax.tree.map(
+                    lambda i, a: jnp.where(start, i, a), init, acc
+                )
                 new = fold_fn(acc, key, nbr, val)
                 acc = jax.tree.map(
                     lambda n, o: jnp.where(ok, n, o), new, acc

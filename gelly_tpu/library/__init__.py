@@ -9,7 +9,7 @@ from .connected_components import (
     connected_components_tree,
     labels_to_components,
 )
-from .degrees import degree_distribution
+from .degrees import degree_distribution, sharded_degrees
 from .iterative_cc import IterativeCCStream
 from .matching import weighted_matching
 from .spanner import spanner, spanner_edges

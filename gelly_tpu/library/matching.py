@@ -150,7 +150,13 @@ class WeightedMatchingStream:
     def events(self) -> Iterator[MatchingEvent]:
         """ADD/REMOVE event stream with raw vertex ids — the reference's
         collector output (WeightedMatchingFlatMapper, ADD at :103-104,
-        REMOVE at :99-101). Host path only."""
+        REMOVE at :99-101). Host path only: a device=True stream must use
+        final()/final_matching() (mixing the two would make results depend
+        on call order across the f32 device and f64 host thresholds)."""
+        if self.device:
+            raise NotImplementedError(
+                "events() is host-path only; use device=False"
+            )
         ctx = self.stream.ctx
         n = ctx.vertex_capacity
         state = MatchingState(
@@ -160,9 +166,15 @@ class WeightedMatchingStream:
         for c in self.stream:
             evs: list = []
             state = _matching_step_host(state, c, evs)
-            for e in evs:
-                a, b = ctx.decode(np.array([e.src, e.dst])).tolist()
-                yield MatchingEvent(e.type, a, b, e.weight)
+            if evs:
+                # One batched decode per chunk (VertexTable probe + array
+                # construction are per-call host costs).
+                flat = np.array([x for e in evs for x in (e.src, e.dst)])
+                raw = ctx.decode(flat).tolist()
+                for i, e in enumerate(evs):
+                    yield MatchingEvent(
+                        e.type, raw[2 * i], raw[2 * i + 1], e.weight
+                    )
         # A full drain just happened: cache it so final()/total_weight()
         # don't recompute the whole stream.
         self._final = state

@@ -210,3 +210,32 @@ def test_matching_same_edge_rematch_single_remove():
     ]
     # events() drain is cached: total_weight must not recompute.
     assert wm.total_weight() == 45.0
+
+
+def test_sharded_degrees_matches_host(devices):
+    from gelly_tpu.library.degrees import sharded_degrees
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.default_rng(7)
+    edges = [(int(a), int(b)) for a, b in rng.integers(0, 60, (200, 2))]
+    m = mesh_lib.make_mesh(8)
+    s1 = edge_stream_from_edges(edges, vertex_capacity=64, chunk_size=32)
+    got = sharded_degrees(s1, mesh=m).final_degrees()
+    s2 = edge_stream_from_edges(edges, vertex_capacity=64, chunk_size=32)
+    expected = s2.get_degrees().final_degrees()
+    assert got == expected
+
+
+def test_sharded_degrees_with_deletions(devices):
+    from gelly_tpu.library.degrees import sharded_degrees
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.make_mesh(8)
+    src = np.array([1, 1, 1]); dst = np.array([2, 3, 2])
+    ev = np.array([0, 0, 1], np.int8)
+    s = edge_stream_from_source(
+        EdgeChunkSource(src, dst, events=ev, chunk_size=2), 64
+    )
+    assert sharded_degrees(s, mesh=m).final_degrees() == {
+        1: 1, 2: 0, 3: 1
+    }

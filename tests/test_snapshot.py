@@ -148,3 +148,19 @@ def test_build_neighborhood_directed(reference_edges):
     nstream = s.build_neighborhood(directed=True)
     assert nstream.neighbors_of(3) == [4, 5]
     assert nstream.neighbors_of(5) == [1]
+
+
+def test_fold_neighbors_tuple_accumulator(reference_edges):
+    # The reference's SumEdgeValues folds into a Tuple2 (id, sum)
+    # (TestSlice.java:203-210): pytree accumulators must work.
+    s = fixture_stream(reference_edges)
+    snap = s.slice(1000, "out")
+    init = (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.float32))
+    got = {}
+    for upd in snap.fold_neighbors(
+        init, lambda acc, v, nbr, val: (v, acc[1] + val)
+    ):
+        for k, (vid, total) in upd.to_pairs(s.ctx):
+            got[k] = (int(vid), int(total))
+    slot_of = {int(r): i for i, r in enumerate(s.ctx.table._rev.tolist())}
+    assert got == {k: (slot_of[k], v) for k, v in EXPECTED["out"].items()}
