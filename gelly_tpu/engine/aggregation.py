@@ -231,6 +231,7 @@ def run_aggregation(
     resume: bool = False,
     prefetch_depth: int = 2,
     device_fields: tuple[str, ...] | None = None,
+    host_precombine: Callable | None = None,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
 
@@ -245,6 +246,12 @@ def run_aggregation(
     thread (e.g. ``("src", "dst", "valid")`` for CC): the H2D of exactly
     the fields the fold reads then overlaps compute, while unused fields
     stay host-side (jit prunes dead args, so they are never transferred).
+
+    ``host_precombine(chunk) -> chunk`` runs on the prefetch thread before
+    staging — an ingest-side partial pre-aggregation (e.g.
+    ``cc_host_precombine`` reduces each chunk to its spanning forest).
+    Ignored in window mode: a pre-combiner may not preserve per-edge
+    timestamps.
 
     ``checkpoint_path`` snapshots the global summary + stream position every
     ``checkpoint_every`` closed windows (the Merger's ListCheckpointed analog,
@@ -338,8 +345,12 @@ def run_aggregation(
         def stage(c):
             # Window mode needs ts/valid host-side (the tumbling iterator
             # reads them per chunk); skip pre-staging there.
-            if device_fields and window_ms is None:
-                return c._replace(**{
+            if window_ms is not None:
+                return c
+            if host_precombine is not None:
+                c = host_precombine(c)
+            if device_fields:
+                c = c._replace(**{
                     f: jax.device_put(getattr(c, f)) for f in device_fields
                 })
             return c
