@@ -37,8 +37,16 @@ def synth_edges(num_edges: int, num_vertices: int, seed: int = 7):
     return perm[src].astype(np.int64), perm[dst].astype(np.int64)
 
 
-def baseline_cc(src: np.ndarray, dst: np.ndarray) -> tuple[dict, float]:
-    """Reference-semantics per-edge union-find fold on host CPU."""
+def baseline_cc(src: np.ndarray, dst: np.ndarray,
+                cap_edges: int = 4_000_000) -> tuple[dict, float, int]:
+    """Reference-semantics per-edge union-find fold on host CPU.
+
+    Folds every edge through ``DisjointSet.union`` semantics one at a time
+    (the reference's actual execution shape). Timed on a prefix of up to
+    ``cap_edges`` (per-edge cost is flat, so the rate extrapolates); the
+    *full* stream is then folded untimed so the parity oracle compares
+    complete label sets.
+    """
     parent: dict[int, int] = {}
 
     def find(x: int) -> int:
@@ -49,12 +57,8 @@ def baseline_cc(src: np.ndarray, dst: np.ndarray) -> tuple[dict, float]:
             parent[x], x = root, parent[x]
         return root
 
-    # Best of 2, symmetric with the accelerator side's repeat policy.
-    dt = float("inf")
-    for _ in range(2):
-        parent.clear()
-        t0 = time.perf_counter()
-        for u, v in zip(src.tolist(), dst.tolist()):
+    def fold(s, d):
+        for u, v in zip(s.tolist(), d.tolist()):
             if u not in parent:
                 parent[u] = u
             if v not in parent:
@@ -65,12 +69,62 @@ def baseline_cc(src: np.ndarray, dst: np.ndarray) -> tuple[dict, float]:
                     parent[rv] = ru
                 else:
                     parent[ru] = rv
+
+    n_timed = min(cap_edges, src.shape[0])
+    # Best of 2, symmetric with the accelerator side's repeat policy.
+    dt = float("inf")
+    for _ in range(2):
+        parent.clear()
+        t0 = time.perf_counter()
+        fold(src[:n_timed], dst[:n_timed])
         dt = min(dt, time.perf_counter() - t0)
+    fold(src[n_timed:], dst[n_timed:])  # untimed remainder for the oracle
     labels = {x: find(x) for x in parent}
-    return labels, dt
+    return labels, dt, n_timed
 
 
-def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int):
+def baseline_cc_numpy(src: np.ndarray, dst: np.ndarray, num_vertices: int,
+                      chunk_size: int, cap_edges: int = 8_000_000) -> float:
+    """Vectorized host baseline with the same streaming semantics.
+
+    The strongest honest CPU comparison: per-chunk spanning-forest reduction
+    (vectorized numpy min-label propagation) folded into a global forest —
+    i.e. the same chunked pipeline as the TPU path, minus the device.
+    Returns measured edges/sec (timed on a prefix of up to ``cap_edges``).
+    """
+    from gelly_tpu.library.connected_components import cc_labels_numpy
+
+    n = min(cap_edges, src.shape[0])
+    s32 = src[:n].astype(np.int32)
+    d32 = dst[:n].astype(np.int32)
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        glob = np.arange(num_vertices, dtype=np.int32)
+        for lo in range(0, n, chunk_size):
+            lab = cc_labels_numpy(
+                s32[lo:lo + chunk_size], d32[lo:lo + chunk_size],
+                None, num_vertices,
+            )
+            ok = lab >= 0
+            # merge chunk forest into the global forest (label propagation)
+            v = np.nonzero(ok)[0].astype(np.int32)
+            r = lab[v]
+            while True:
+                prev = glob
+                mn = np.minimum(glob[v], glob[r])
+                glob = glob.copy()
+                np.minimum.at(glob, v, mn)
+                np.minimum.at(glob, r, mn)
+                glob = np.minimum(glob, glob[glob])
+                if np.array_equal(glob, prev):
+                    break
+        dt = min(dt, time.perf_counter() - t0)
+    return n / dt
+
+
+def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int,
+           fold_batch: int):
     import jax
 
     from gelly_tpu import edge_stream_from_edges  # noqa: F401  (registers x64)
@@ -87,28 +141,34 @@ def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int):
                                table=IdentityVertexTable(num_vertices))
         return edge_stream_from_source(srcq, num_vertices)
 
+    # The ingest codec (native C++ chunk combiner -> compressed forest
+    # payloads -> batched device union) is the default CC plan; see
+    # gelly_tpu/library/connected_components.py.
     agg = connected_components(num_vertices, merge="gather")
 
-    # Warmup: compile fold/merge on a tiny prefix.
-    warm = EdgeChunkSource(src[: chunk_size * 2], dst[: chunk_size * 2],
-                           chunk_size=chunk_size,
+    # Warmup: compile fold/merge on a tiny prefix (same static shapes).
+    warm_n = min(src.shape[0], chunk_size * fold_batch)
+    warm = EdgeChunkSource(src[:warm_n], dst[:warm_n], chunk_size=chunk_size,
                            table=IdentityVertexTable(num_vertices))
     warm_stream = edge_stream_from_source(warm, num_vertices)
-    warm_stream.aggregate(agg, merge_every=merge_every).result()
+    warm_stream.aggregate(agg, merge_every=merge_every,
+                          fold_batch=fold_batch).result()
 
     # Best of 2 timed passes: the timed region ends in a real D2H pull
     # (completion barrier), and the repeat damps transient load on the
     # shared device link.
     dt = float("inf")
+    timer = None
     for _ in range(2):
         stream = make_stream()
         t0 = time.perf_counter()
-        labels = stream.aggregate(
-            agg, merge_every=merge_every, device_fields=("src", "dst", "valid")
-        ).result()
-        labels = np.asarray(labels)  # real completion barrier (D2H pull)
-        dt = min(dt, time.perf_counter() - t0)
-    return labels, stream.ctx, dt
+        res = stream.aggregate(agg, merge_every=merge_every,
+                               fold_batch=fold_batch)
+        labels = np.asarray(res.result())  # real completion barrier (D2H)
+        t = time.perf_counter() - t0
+        if t < dt:
+            dt, timer = t, res.timer
+    return labels, stream.ctx, dt, timer
 
 
 def components_of(labels_by_id: dict) -> set[frozenset]:
@@ -337,43 +397,19 @@ def bench_matching(args):
     return "weighted_matching_throughput", n_e / dt, n_e / dt_base
 
 
-def main() -> int:
-    p = argparse.ArgumentParser()
-    p.add_argument("--workload", default="cc",
-                   choices=["cc", "degrees", "triangles", "bipartiteness",
-                            "matching"])
-    p.add_argument("--edges", type=int, default=2_000_000)
-    p.add_argument("--vertices", type=int, default=1 << 17)
-    p.add_argument("--chunk-size", type=int, default=1 << 18)
-    p.add_argument("--merge-every", type=int, default=8)
-    p.add_argument("--skip-parity", action="store_true")
-    args = p.parse_args()
-
-    if args.workload != "cc":
-        fn = {
-            "degrees": bench_degrees,
-            "triangles": bench_triangles,
-            "bipartiteness": bench_bipartiteness,
-            "matching": bench_matching,
-        }[args.workload]
-        metric, eps, base_eps = fn(args)
-        print(json.dumps({
-            "metric": metric,
-            "value": round(eps, 1),
-            "unit": "edges/sec",
-            "vs_baseline": round(eps / base_eps, 2),
-        }))
-        return 0
-
+def bench_cc(args) -> dict:
+    """North-star workload #2: streaming Connected Components."""
     src, dst = synth_edges(args.edges, args.vertices)
 
-    labels, ctx, dt_tpu = tpu_cc(
-        src, dst, args.vertices, args.chunk_size, args.merge_every
+    labels, ctx, dt_tpu, timer = tpu_cc(
+        src, dst, args.vertices, args.chunk_size, args.merge_every,
+        args.fold_batch,
     )
     eps = args.edges / dt_tpu
 
-    base_labels, dt_base = baseline_cc(src, dst)
-    base_eps = args.edges / dt_base
+    base_labels, dt_base, n_base = baseline_cc(src, dst)
+    base_eps = n_base / dt_base
+    numpy_eps = baseline_cc_numpy(src, dst, args.vertices, args.chunk_size)
 
     if not args.skip_parity:
         lab = np.asarray(labels)
@@ -384,19 +420,86 @@ def main() -> int:
         )
         theirs = components_of(base_labels)
         if ours != theirs:
-            print(
-                json.dumps({"error": "label parity FAILED",
-                            "ours": len(ours), "theirs": len(theirs)}),
-                file=sys.stderr,
-            )
-            return 1
+            raise SystemExit(json.dumps({
+                "error": "label parity FAILED",
+                "ours": len(ours), "theirs": len(theirs),
+            }))
 
-    print(json.dumps({
+    stages = {
+        k: round(v["total_s"], 4)
+        for k, v in (timer.report() if timer else {}).items()
+    }
+    stages["total_wall"] = round(dt_tpu, 4)
+    return {
         "metric": "streaming_cc_throughput",
         "value": round(eps, 1),
         "unit": "edges/sec",
         "vs_baseline": round(eps / base_eps, 2),
-    }))
+        # Hardened comparison: vectorized numpy host pipeline with the same
+        # chunked streaming semantics (VERDICT r1 item 5). vs_baseline keeps
+        # the reference-semantics per-edge fold as its denominator for
+        # round-over-round comparability.
+        "vs_numpy_stream": round(eps / numpy_eps, 2),
+        # Stage seconds are thread-summed (ingest stages run on 2 workers),
+        # so they can exceed total_wall.
+        "stages": stages,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--workload", default="all",
+                   choices=["all", "cc", "degrees", "triangles",
+                            "bipartiteness", "matching"])
+    p.add_argument("--edges", type=int, default=64_000_000)
+    p.add_argument("--vertices", type=int, default=1 << 17)
+    p.add_argument("--chunk-size", type=int, default=1 << 21)
+    p.add_argument("--merge-every", type=int, default=4)
+    p.add_argument("--fold-batch", type=int, default=4)
+    p.add_argument("--skip-parity", action="store_true")
+    args = p.parse_args()
+
+    others = {
+        "degrees": bench_degrees,
+        "triangles": bench_triangles,
+        "bipartiteness": bench_bipartiteness,
+        "matching": bench_matching,
+    }
+
+    # Non-CC workloads keep per-edge python baselines: clamp their sizes so
+    # a single-workload run doesn't inherit the CC-scale 64M default.
+    small = argparse.Namespace(**vars(args))
+    small.edges = min(args.edges, 2_000_000)
+    small.chunk_size = min(args.chunk_size, 1 << 18)
+    small.merge_every = 8
+
+    if args.workload == "cc":
+        print(json.dumps(bench_cc(args)))
+        return 0
+    if args.workload != "all":
+        metric, eps, base_eps = others[args.workload](small)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(eps, 1),
+            "unit": "edges/sec",
+            "vs_baseline": round(eps / base_eps, 2),
+        }))
+        return 0
+
+    # Default: all five BASELINE workloads, one JSON line each; the
+    # north-star CC line prints LAST so a last-line parser records it.
+    for name, fn in others.items():
+        try:
+            metric, eps, base_eps = fn(small)
+            print(json.dumps({
+                "metric": metric,
+                "value": round(eps, 1),
+                "unit": "edges/sec",
+                "vs_baseline": round(eps / base_eps, 2),
+            }))
+        except SystemExit as e:
+            print(json.dumps({"metric": name, "error": str(e)}))
+    print(json.dumps(bench_cc(args)))
     return 0
 
 

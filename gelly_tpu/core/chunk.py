@@ -98,6 +98,27 @@ class EdgeChunk(NamedTuple):
         return c.src[m], c.dst[m], c.val[m]
 
 
+# Shared read-only default fields, cached per (capacity, kind): chunk
+# construction is on the ingest critical path, and re-allocating ones/zeros
+# per chunk costs tens of ms at multi-million-edge chunk sizes. Consumers
+# treat chunk fields as immutable (pure-functional discipline), so sharing
+# is safe.
+_const_cache: dict = {}
+
+
+def _const(cap: int, kind: str, dtype) -> np.ndarray:
+    key = (cap, kind, np.dtype(dtype))
+    out = _const_cache.get(key)
+    if out is None:
+        if kind == "ones":
+            out = np.ones((cap,), dtype)
+        else:
+            out = np.zeros((cap,), dtype)
+        out.setflags(write=False)
+        _const_cache[key] = out
+    return out
+
+
 def make_chunk(
     src,
     dst,
@@ -120,10 +141,12 @@ def make_chunk(
     ``device=False`` keeps the fields as numpy: the H2D transfer then happens
     lazily when a jitted consumer first touches the chunk, and host-side
     window logic (timestamp reads, direction transforms) costs no device
-    round-trips — the right mode for ingest sources.
+    round-trips — the right mode for ingest sources. Full chunks (n ==
+    capacity) of already-right-dtype arrays are zero-copy views; default
+    val/event/valid fields are shared cached constants.
     """
-    src = np.asarray(src, dtype=np.int32)
-    dst = np.asarray(dst, dtype=np.int32)
+    src = np.asarray(src)
+    dst = np.asarray(dst)
     n = src.shape[0]
     if dst.shape[0] != n:
         raise ValueError(f"src/dst length mismatch: {n} vs {dst.shape[0]}")
@@ -132,7 +155,11 @@ def make_chunk(
         raise ValueError(f"capacity {cap} < number of edges {n}")
 
     def pad(a, dtype):
-        a = np.asarray(a, dtype=dtype)
+        dtype = np.dtype(dtype)
+        a = np.asarray(a)
+        if a.dtype == dtype and a.shape[0] == cap:
+            return a  # zero-copy fast path (full chunk, right dtype)
+        a = a.astype(dtype, copy=False)
         out = np.zeros((cap,) + a.shape[1:], dtype=dtype)
         out[:n] = a
         return out
@@ -140,11 +167,18 @@ def make_chunk(
     raw_src = src if raw_src is None else raw_src
     raw_dst = dst if raw_dst is None else raw_dst
     if val is None:
-        val = np.ones((n,), dtype=np.dtype(val_dtype))
+        val = (
+            _const(cap, "ones", val_dtype)
+            if n == cap
+            else np.ones((n,), dtype=np.dtype(val_dtype))
+        )
     ts = np.arange(n, dtype=np.int64) if ts is None else ts
-    event = np.zeros((n,), dtype=np.int8) if event is None else event
-    valid = np.zeros((cap,), dtype=bool)
-    valid[:n] = True
+    event = _const(cap, "zeros", np.int8) if event is None else pad(event, np.int8)
+    if n == cap:
+        valid = _const(cap, "ones", bool)
+    else:
+        valid = np.zeros((cap,), dtype=bool)
+        valid[:n] = True
     put = jnp.asarray if device else (lambda a: a)
     return EdgeChunk(
         src=put(pad(src, np.int32)),
@@ -153,7 +187,7 @@ def make_chunk(
         raw_dst=put(pad(raw_dst, np.int64)),
         val=put(pad(val, np.dtype(val_dtype))),
         ts=put(pad(ts, np.int64)),
-        event=put(pad(event, np.int8)),
+        event=put(event),
         valid=put(valid),
     )
 

@@ -161,10 +161,10 @@ class IdentityVertexTable:
                     f"vertex id {hi} out of range for capacity {self.capacity}"
                 )
             self._max_seen = max(self._max_seen, hi)
-        return raw_ids.astype(np.int32)
+        return raw_ids.astype(np.int32, copy=False)
 
     def lookup(self, raw_ids: np.ndarray) -> np.ndarray:
-        return np.asarray(raw_ids).ravel().astype(np.int32)
+        return np.asarray(raw_ids).ravel().astype(np.int32, copy=False)
 
     def decode(self, slots: np.ndarray) -> np.ndarray:
         return np.asarray(slots).astype(np.int64)

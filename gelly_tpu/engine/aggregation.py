@@ -75,6 +75,17 @@ class SummaryAggregation:
     # False for transforms doing host-side / non-traceable work.
     jit_transform: bool = True
     merge_stacked: Callable[[Summary], Summary] | None = None
+    # Optional ingest codec: ``host_compress(chunk) -> payload`` runs on the
+    # prefetch thread and pre-aggregates a chunk into a compact numpy pytree
+    # (the reference's per-partition partial fold relocated to the ingest
+    # side, M/SummaryBulkAggregation.java:76-80); ``fold_compressed(summary,
+    # stacked_payload)`` folds a [K]-stacked batch of payloads on device.
+    # Both must be set for the codec path to engage; it cuts H2D bytes by
+    # 1-2 orders of magnitude, which is the scarce resource on the
+    # host->device link. Ignored in window mode (payloads carry no
+    # per-edge timestamps).
+    host_compress: Callable[[EdgeChunk], Any] | None = None
+    fold_compressed: Callable[[Summary, Any], Summary] | None = None
     name: str = "aggregation"
 
 
@@ -165,6 +176,22 @@ def _compiled_plan(agg: SummaryAggregation, m):
 
         fold_step = jax.jit(agg.fold)
         merge_locals = jax.jit(lambda s: s)
+
+        @jax.jit
+        def fold_many(s, stacked_chunk):
+            # K chunks in one dispatch: scan the fold over the stacked
+            # leading axis. Dispatch round-trips (~15ms each on a tunneled
+            # device) amortize K-fold.
+            def step(acc, ck):
+                return agg.fold(acc, ck), None
+
+            s, _ = jax.lax.scan(step, s, stacked_chunk)
+            return s
+
+        if agg.fold_compressed is not None:
+            fold_codec = jax.jit(agg.fold_compressed)
+        else:
+            fold_codec = None
     else:
         @partial(jax.jit, out_shardings=sharded)
         def fold_step(locals_, chunk):
@@ -198,6 +225,26 @@ def _compiled_plan(agg: SummaryAggregation, m):
             # All shards hold the identical global merge; take shard 0.
             return unshard_leaf(merged)
 
+        fold_many = None  # chunk batching is the S=1 dispatch-amortizer
+
+        if agg.fold_compressed is not None:
+            # Codec payloads are data-parallel over the chunk axis: a batch
+            # of K payloads arrives as [S, K/S, ...]-sharded leaves and each
+            # device folds its K/S payloads into its local summary.
+            @partial(jax.jit, out_shardings=sharded)
+            def fold_codec(locals_, payload):
+                def body(loc, pl):
+                    s = unshard_leaf(loc)
+                    p = jax.tree.map(lambda x: x[0], pl)
+                    return shard_leaf(agg.fold_compressed(s, p))
+
+                return mesh_lib.shard_map_fn(
+                    m, body, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    out_specs=P(SHARD_AXIS),
+                )(locals_, payload)
+        else:
+            fold_codec = None
+
     @jax.jit
     def merger_step(window_summary, global_summary):
         # The parallelism-1 Merger (M/SummaryAggregation.java:107-119):
@@ -215,7 +262,7 @@ def _compiled_plan(agg: SummaryAggregation, m):
         transform_fn = agg.transform
 
     plan = (fold_step, merge_locals, merger_step, locals0_fn,
-            transform_fn)
+            transform_fn, fold_many, fold_codec)
     per_agg[key] = plan
     return plan
 
@@ -232,6 +279,9 @@ def run_aggregation(
     prefetch_depth: int = 2,
     device_fields: tuple[str, ...] | None = None,
     host_precombine: Callable | None = None,
+    fold_batch: int = 1,
+    ingest_workers: int = 2,
+    timer=None,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
 
@@ -257,6 +307,19 @@ def run_aggregation(
     ``checkpoint_every`` closed windows (the Merger's ListCheckpointed analog,
     M/SummaryAggregation.java:127-135); ``resume=True`` reloads it and skips
     the already-folded chunks.
+
+    ``fold_batch`` groups up to that many chunks into one device dispatch
+    (clamped to a divisor of ``merge_every``): the fold scans the stacked
+    batch in a single program, amortizing per-dispatch latency. When the
+    aggregation defines an ingest codec (``host_compress``/
+    ``fold_compressed``), batches are compressed payload stacks instead of
+    raw chunks — the high-throughput path on a bandwidth-limited
+    host->device link.
+
+    ``timer`` (a ``utils.metrics.StageTimer``) accumulates per-stage
+    wall-clock: ``ingest_compress`` / ``h2d`` (prefetch thread),
+    ``fold_dispatch`` / ``merge_emit`` (consumer). Also exposed as
+    ``stream.timer``.
     """
     if merge_every is not None and window_ms is not None:
         raise ValueError("pass at most one of merge_every / window_ms")
@@ -264,10 +327,37 @@ def run_aggregation(
         merge_every = 1
 
     m = mesh if mesh is not None else mesh_lib.make_mesh()
+    S = mesh_lib.num_shards(m)
     plan = _compiled_plan(agg, m)
     (fold_step, merge_locals, merger_step, locals0_fn,
-     transform_fn) = plan
+     transform_fn, fold_many, fold_codec) = plan
     locals0 = locals0_fn()
+
+    if timer is None:
+        from ..utils.metrics import StageTimer
+
+        timer = StageTimer()
+
+    use_codec = (
+        agg.host_compress is not None
+        and agg.fold_compressed is not None
+        and window_ms is None
+    )
+    # Effective batch: a divisor of merge_every so window boundaries align
+    # with batch boundaries; on a sharded codec plan, also a multiple of S
+    # (the payload batch axis is split across devices).
+    batch = 1
+    if window_ms is None:
+        batch = max(1, min(fold_batch, merge_every))
+        while merge_every % batch:
+            batch -= 1
+        if use_codec and S > 1:
+            if batch % S:
+                batch = S if merge_every % S == 0 else 1
+            if batch % S:
+                use_codec = False  # no aligned batching possible
+        if batch > 1 and not use_codec and fold_many is None:
+            batch = 1  # raw-chunk batching is the S=1 dispatch amortizer
 
     stats = {"late_edges": 0, "windows_closed": 0, "chunks": 0}
 
@@ -378,6 +468,94 @@ def run_aggregation(
                     continue
                 yield chunk
 
+        def produced_units():
+            # Batched producer for merge_every mode: groups of up to
+            # ``batch`` host chunks. Resume-skipped chunks are dropped here
+            # (they were consumed in the checkpointed run; chunks_consumed
+            # starts at skip_until).
+            idx = 0
+            group: list = []
+            it = iter(stream)
+            while True:
+                with timer("ingest_chunks"):
+                    chunk = next(it, None)
+                if chunk is None:
+                    break
+                idx += 1
+                if idx <= skip_until:
+                    continue
+                group.append(chunk)
+                if len(group) == batch:
+                    yield group
+                    group = []
+            if group:
+                yield group
+
+        def _pad_group(group):
+            # Pad the final partial batch to the static batch size so the
+            # stacked shapes (and hence the compiled program) never change.
+            if len(group) == batch:
+                return group
+            c0 = group[0].to_numpy()
+            zero = EdgeChunk(*(np.zeros_like(f) for f in c0))
+            return group + [zero] * (batch - len(group))
+
+        identity_payload = None
+        if use_codec:
+            from ..core.chunk import make_chunk
+
+            empty = make_chunk(
+                np.zeros(0, np.int64), np.zeros(0, np.int64),
+                capacity=1, device=False,
+            )
+            identity_payload = agg.host_compress(empty)
+
+        def stage_unit(group):
+            k = len(group)
+            if use_codec:
+                with timer("ingest_compress"):
+                    payloads = [agg.host_compress(c) for c in group]
+                    if k < batch:
+                        payloads += [identity_payload] * (batch - k)
+                    stacked = jax.tree.map(
+                        lambda *ls: np.stack(ls), *payloads
+                    )
+                    if S > 1:
+                        # [K, ...] -> [S, K/S, ...]: chunk-data-parallel
+                        # split of the batch axis across devices.
+                        stacked = jax.tree.map(
+                            lambda x: x.reshape((S, batch // S) + x.shape[1:]),
+                            stacked,
+                        )
+                with timer("h2d"):
+                    if S > 1:
+                        dev = mesh_lib.device_put_sharded_leading(m, stacked)
+                    else:
+                        dev = jax.device_put(stacked)
+                    # Block on the prefetch thread (not the consumer): the
+                    # recorded h2d time is the real transfer, and the fold
+                    # dispatch never waits on an in-flight upload.
+                    jax.block_until_ready(dev)
+                return dev, k
+            if batch > 1:
+                with timer("ingest_compress"):
+                    group = [
+                        host_precombine(c) if host_precombine else c
+                        for c in group
+                    ]
+                    group = [c.to_numpy() for c in _pad_group(group)]
+                    stacked = EdgeChunk(
+                        *(np.stack(fs) for fs in zip(*group))
+                    )
+                with timer("h2d"):
+                    if device_fields:
+                        stacked = stacked._replace(**{
+                            f: jax.device_put(getattr(stacked, f))
+                            for f in device_fields
+                        })
+                return stacked, k
+            return stage(group[0]), k
+
         if window_ms is not None:
             # Tumbling timestamp windows via the shared iterator
             # (core/windows.py): no-data windows never fire, late edges are
@@ -399,18 +577,38 @@ def run_aggregation(
             if checkpoint_path and stats["windows_closed"]:
                 maybe_checkpoint(force=True)
         else:
-            for chunk in counted_chunks():
-                locals_ = fold_step(locals_, chunk)
-                chunks_in_window += 1
+            chunks_consumed = skip_until
+            if use_codec:
+                fold_unit = fold_codec
+            elif batch > 1:
+                fold_unit = fold_many
+            else:
+                fold_unit = fold_step
+            from ..utils.prefetch import prefetch_map
+
+            for unit, k in prefetch_map(
+                stage_unit, produced_units(), depth=prefetch_depth,
+                workers=ingest_workers,
+            ):
+                chunks_consumed += k
+                stats["chunks"] = chunks_consumed
+                with timer("fold_dispatch"):
+                    locals_ = fold_unit(locals_, unit)
+                chunks_in_window += k
                 dirty = True
                 if chunks_in_window >= merge_every:
-                    yield close_window()
+                    with timer("merge_emit"):
+                        out = close_window()
                     chunks_in_window = 0
+                    yield out
                 maybe_checkpoint()
             if dirty:
-                yield close_window()
+                with timer("merge_emit"):
+                    out = close_window()
+                yield out
                 maybe_checkpoint(force=True)
 
     out_stream = SummaryStream(gen)
     out_stream.stats = stats
+    out_stream.timer = timer
     return out_stream

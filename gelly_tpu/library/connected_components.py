@@ -20,6 +20,7 @@ final parity oracle (component-set equality, as the reference's test asserts,
 
 from __future__ import annotations
 
+import subprocess
 from typing import NamedTuple
 
 import jax
@@ -36,13 +37,63 @@ class CCSummary(NamedTuple):
     seen: jax.Array  # bool[N] vertices observed in the stream
 
 
+_NATIVE_STATE = {"ok": None}
+
+
+def _native_ok() -> bool:
+    """Probe the native combiner once; negative-cache failures so a missing
+    toolchain doesn't re-run g++ per chunk on the ingest hot path."""
+    if _NATIVE_STATE["ok"] is None:
+        try:
+            from ..utils import native
+
+            native._load_combiner()
+            _NATIVE_STATE["ok"] = True
+        except (OSError, subprocess.SubprocessError, AttributeError):
+            _NATIVE_STATE["ok"] = False
+    return _NATIVE_STATE["ok"]
+
+
+def cc_labels_numpy(src: np.ndarray, dst: np.ndarray,
+                    valid: np.ndarray | None, n_v: int) -> np.ndarray:
+    """Pure-numpy fallback for the native chunk combiner: spanning-forest
+    labels i32[n_v] of one chunk (-1 for untouched slots)."""
+    if valid is not None:
+        m = np.asarray(valid, bool)
+        src, dst = np.asarray(src)[m], np.asarray(dst)[m]
+    lab = np.full((n_v,), -1, np.int32)
+    if src.size == 0:
+        return lab
+    touched = np.zeros((n_v,), bool)
+    touched[src] = True
+    touched[dst] = True
+    lab[touched] = np.nonzero(touched)[0].astype(np.int32)
+    while True:
+        prev = lab.copy()
+        mn = np.minimum(lab[src], lab[dst]).astype(np.int32)
+        np.minimum.at(lab, src, mn)
+        np.minimum.at(lab, dst, mn)
+        t = np.nonzero(touched)[0]
+        lab[t] = np.minimum(lab[t], lab[lab[t]])
+        if np.array_equal(lab, prev):
+            break
+    return lab
+
+
 def connected_components(
-    vertex_capacity: int, merge: str = "tree"
+    vertex_capacity: int, merge: str = "tree", ingest_combine: bool = True
 ) -> SummaryAggregation:
     """Build the CC aggregation over a slot space of ``vertex_capacity``.
 
     ``merge="tree"`` → butterfly merge-tree (ConnectedComponentsTree);
     ``merge="gather"`` → all_gather + stacked union (flat bulk aggregation).
+
+    ``ingest_combine`` (default on) attaches the ingest codec: each chunk is
+    pre-reduced on the host to its spanning forest (the reference's
+    per-partition partial fold, M/SummaryBulkAggregation.java:76-80, moved
+    to the ingest side) and shipped as a dense i32 label array — 1-2 orders
+    of magnitude fewer H2D bytes per edge. The device then unions the
+    (vertex, root) star edges, preserving connectivity exactly.
     """
     n = vertex_capacity
 
@@ -56,6 +107,33 @@ def connected_components(
         seen = segments.mark_seen(s.seen, chunk.src, chunk.valid)
         seen = segments.mark_seen(seen, chunk.dst, chunk.valid)
         return CCSummary(parent, seen)
+
+    def host_compress(chunk) -> np.ndarray:
+        if _native_ok():
+            from ..utils.native import cc_chunk_combine
+
+            return cc_chunk_combine(
+                np.asarray(chunk.src), np.asarray(chunk.dst),
+                np.asarray(chunk.valid), n,
+            )
+        return cc_labels_numpy(chunk.src, chunk.dst, chunk.valid, n)
+
+    def fold_compressed(s: CCSummary, labels: jax.Array) -> CCSummary:
+        # labels: i32[K, n] — K chunk forests. Every (v, labels[k, v] >= 0)
+        # pair is a union edge; one joint fixpoint unions all K at once
+        # (cheaper than K sequential fixpoints — the star edges from
+        # different chunks hook through each other in the same rounds).
+        k = labels.shape[0]
+        present = jnp.any(labels >= 0, axis=0)
+        v = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32), (k, n)
+        ).reshape(-1)
+        lab = labels.reshape(-1)
+        ok = lab >= 0
+        parent = unionfind.union_edges(
+            s.parent, v, jnp.where(ok, lab, 0).astype(jnp.int32), ok
+        )
+        return CCSummary(parent, s.seen | present)
 
     def combine(a: CCSummary, b: CCSummary) -> CCSummary:
         return CCSummary(
@@ -79,6 +157,8 @@ def connected_components(
         transform=transform,
         merge_stacked=merge_stacked if merge == "gather" else None,
         transient=False,
+        host_compress=host_compress if ingest_combine else None,
+        fold_compressed=fold_compressed if ingest_combine else None,
         name=f"connected-components-{merge}",
     )
 

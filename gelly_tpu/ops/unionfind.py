@@ -57,17 +57,29 @@ def union_edges(parent: jax.Array, src: jax.Array, dst: jax.Array,
     (``M/library/ConnectedComponents.java:82-87`` does exactly this per edge),
     but order-free: hooking always links larger root to smaller, so the result
     is the same canonical forest regardless of edge order.
+
+    Shiloach-Vishkin shape: each round does one masked scatter-min hook and
+    ONE pointer-doubling step, converging in O(log n) rounds total. (A full
+    path compression per hook round — the naive nesting — costs ~depth
+    gathers per round; interleaving instead keeps the whole union at ~log
+    rounds of one gather+scatter each, which is what the TPU's serialized
+    while_loop iterations want.)
+
+    Invariants: ``parent[i] <= i`` and updates only decrease entries, so the
+    loop is monotone and terminates. At a no-change fixpoint the forest is
+    flat (else doubling would change it) and every valid edge has equal
+    labels (else the hook's scatter-min onto the flat root would lower it).
     """
 
     def body(state):
         p, _ = state
-        p = pointer_jump(p)
-        ru = p[src]
-        rv = p[dst]
-        lo = jnp.minimum(ru, rv)
-        hi = jnp.maximum(ru, rv)
+        lu = p[src]
+        lv = p[dst]
+        lo = jnp.minimum(lu, lv)
+        hi = jnp.maximum(lu, lv)
         live = valid & (lo != hi)
         p2 = masked_scatter_min(p, hi, lo, live)
+        p2 = p2[p2]  # one doubling step (monotone: p2[i] <= i elementwise)
         return p2, jnp.any(p2 != p)
 
     def cond(state):

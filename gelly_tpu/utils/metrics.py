@@ -22,11 +22,19 @@ import numpy as np
 
 
 class StageTimer:
-    """Accumulates wall-clock per named stage: ``with timer("fold"): ...``"""
+    """Accumulates wall-clock per named stage: ``with timer("fold"): ...``
+
+    Thread-safe: ingest stages are timed concurrently from prefetch worker
+    threads while the consumer times fold/merge, so the read-modify-write
+    accumulation takes a lock.
+    """
 
     def __init__(self):
+        import threading
+
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def __call__(self, stage: str):
@@ -34,8 +42,10 @@ class StageTimer:
         try:
             yield
         finally:
-            self.totals[stage] += time.perf_counter() - t0
-            self.counts[stage] += 1
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.totals[stage] += dt
+                self.counts[stage] += 1
 
     def report(self) -> dict[str, dict[str, float]]:
         return {

@@ -1,13 +1,18 @@
 """ctypes bindings for the native runtime components.
 
-Builds ``native/edgelist_parser.cc`` with g++ on first use (cached as a
-shared object next to the source; no pip/pybind dependency) and exposes
+Builds the C++ sources under ``native/`` with g++ on first use (cached as
+shared objects next to the source; no pip/pybind dependency) and exposes
 
 - :func:`parse_edge_list_file` — int64 COO arrays straight from disk, with
-  the comment/whitespace conventions of the reference's readers.
+  the comment/whitespace conventions of the reference's readers
+  (``native/edgelist_parser.cc``);
+- :func:`cc_chunk_combine` / :func:`parity_chunk_combine` — ingest-side
+  chunk pre-aggregation: union-find (plain / parity) over one chunk,
+  emitting a dense spanning-forest label array for compressed H2D transfer
+  (``native/chunk_combiner.cc``).
 
 Import failures (no compiler, read-only tree) degrade gracefully: callers
-(``core/io.py``) fall back to the pure-numpy parser.
+fall back to pure-numpy implementations.
 """
 
 from __future__ import annotations
@@ -19,32 +24,40 @@ import threading
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
-_SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "edgelist_parser.cc"))
-_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "libedgelist_parser.so"))
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native")
+)
 
 _lock = threading.Lock()
-_lib = None
+_libs: dict = {}
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
-def _build() -> None:
-    subprocess.run(
-        ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
-        check=True, capture_output=True,
-    )
+def _load_lib(stem: str) -> ctypes.CDLL:
+    """Compile native/<stem>.cc to lib<stem>.so (mtime-cached) and dlopen it."""
+    with _lock:
+        if stem in _libs:
+            return _libs[stem]
+        src = os.path.join(_NATIVE_DIR, f"{stem}.cc")
+        so = os.path.join(_NATIVE_DIR, f"lib{stem}.so")
+        if not os.path.exists(so) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(so)
+        ):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", so, src],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(so)
+        _libs[stem] = lib
+        return lib
 
 
 def _load() -> ctypes.CDLL:
-    global _lib
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        ):
-            _build()
-        lib = ctypes.CDLL(_SO)
+    lib = _load_lib("edgelist_parser")
+    if not getattr(lib, "_sigs_set", False):
         lib.parse_edge_list.restype = ctypes.c_int
         lib.parse_edge_list.argtypes = [
             ctypes.c_char_p,
@@ -60,8 +73,76 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_double),
         ]
-        _lib = lib
-        return lib
+        lib._sigs_set = True
+    return lib
+
+
+def _load_combiner() -> ctypes.CDLL:
+    lib = _load_lib("chunk_combiner")
+    if not getattr(lib, "_sigs_set", False):
+        lib.cc_chunk_combine.restype = ctypes.c_int
+        lib.cc_chunk_combine.argtypes = [
+            _i32p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int32, _i32p,
+        ]
+        lib.parity_chunk_combine.restype = ctypes.c_int
+        lib.parity_chunk_combine.argtypes = [
+            _i32p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int32,
+            _i32p, _u8p, _i32p,
+        ]
+        lib._sigs_set = True
+    return lib
+
+
+def _as_i32p(a: np.ndarray):
+    return a.ctypes.data_as(_i32p)
+
+
+def cc_chunk_combine(src: np.ndarray, dst: np.ndarray,
+                     valid: np.ndarray | None, n_v: int) -> np.ndarray:
+    """Spanning-forest labels i32[n_v] of one chunk; -1 for untouched slots.
+
+    ``src``/``dst`` are dense i32 slots; ``valid`` an optional bool mask.
+    ctypes releases the GIL during the call, so combiner work for different
+    chunks can overlap on a thread pool.
+    """
+    lib = _load_combiner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    labels = np.empty((n_v,), np.int32)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    rc = lib.cc_chunk_combine(
+        _as_i32p(src), _as_i32p(dst), vp, src.shape[0], n_v, _as_i32p(labels)
+    )
+    if rc != 0:
+        raise ValueError(f"cc_chunk_combine: vertex slot out of range (rc={rc})")
+    return labels
+
+
+def parity_chunk_combine(src: np.ndarray, dst: np.ndarray,
+                         valid: np.ndarray | None, n_v: int):
+    """(labels i32[n_v], parity u8[n_v], conflict bool) of one chunk."""
+    lib = _load_combiner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    labels = np.empty((n_v,), np.int32)
+    parity = np.empty((n_v,), np.uint8)
+    conflict = ctypes.c_int32(0)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    rc = lib.parity_chunk_combine(
+        _as_i32p(src), _as_i32p(dst), vp, src.shape[0], n_v,
+        _as_i32p(labels), parity.ctypes.data_as(_u8p), ctypes.byref(conflict),
+    )
+    if rc != 0:
+        raise ValueError(
+            f"parity_chunk_combine: vertex slot out of range (rc={rc})"
+        )
+    return labels, parity, bool(conflict.value)
 
 
 def parse_edge_list_file(path: str, want_vals: bool = False):

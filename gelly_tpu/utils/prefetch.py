@@ -52,8 +52,16 @@ def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
                 if cancel.is_set():
                     return
         except BaseException as e:  # re-raised at the consumer
-            if not cancel.is_set():
-                q.put(_Error(e))
+            # Same timeout-and-check-cancel polling as the item puts: a
+            # plain blocking put could hang this daemon thread forever (and
+            # silently drop the exception) if the consumer is gone while
+            # the queue is full.
+            while not cancel.is_set():
+                try:
+                    q.put(_Error(e), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
         finally:
             # Blocking put with cancel checks: the queue may be full, and
             # the consumer needs _DONE to terminate — but must not deadlock
@@ -78,3 +86,70 @@ def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
             yield item
     finally:
         cancel.set()
+
+
+def prefetch_map(fn, it: Iterable, depth: int = 2,
+                 workers: int = 2) -> Iterator:
+    """Ordered parallel map with bounded lookahead.
+
+    Applies ``fn`` to up to ``depth`` upcoming items of ``it`` on a pool of
+    ``workers`` threads, yielding results in input order. This is the
+    multi-worker ingest stage: chunk compression (ctypes releases the GIL)
+    and H2D transfer for different chunks overlap each other and the
+    consumer's device dispatches. Falls back to a plain map when depth or
+    workers is 0.
+
+    Cancellation-safe like :func:`prefetch`: abandoning the generator stops
+    the submitter thread and drains outstanding futures.
+    """
+    if depth <= 0 or workers <= 0:
+        yield from map(fn, it)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    cancel = threading.Event()
+    pool = ThreadPoolExecutor(max_workers=workers)
+
+    def submitter():
+        try:
+            for item in it:
+                fut = pool.submit(fn, item)
+                while not cancel.is_set():
+                    try:
+                        q.put(fut, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if cancel.is_set():
+                    fut.cancel()
+                    return
+        except BaseException as e:
+            while not cancel.is_set():
+                try:
+                    q.put(_Error(e), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+        finally:
+            while True:
+                try:
+                    q.put(_DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    if cancel.is_set():
+                        break
+
+    t = threading.Thread(target=submitter, daemon=True)
+    t.start()
+    try:
+        while True:
+            got = q.get()
+            if got is _DONE:
+                return
+            if isinstance(got, _Error):
+                raise got.exc
+            yield got.result()  # re-raises fn's exception in order
+    finally:
+        cancel.set()
+        pool.shutdown(wait=False, cancel_futures=True)

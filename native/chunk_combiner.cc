@@ -1,0 +1,126 @@
+// Native ingest-side chunk combiners.
+//
+// The reference pre-aggregates per partition before the global merge
+// (SummaryBulkAggregation's per-partition window fold,
+// /root/reference/src/main/java/org/apache/flink/graph/streaming/SummaryBulkAggregation.java:76-80,
+// folding DisjointSet.union per edge, .../summaries/DisjointSet.java:92-118).
+// On TPU the ingest link (host->device) is the scarce resource, so the same
+// partial aggregation runs *before* the transfer: a chunk of E edges is
+// reduced to its spanning forest — at most min(E, n_v) (vertex, root) pairs,
+// shipped as a dense i32 label array. Connectivity is preserved exactly;
+// bytes-per-edge on the wire drops by 1-2 orders of magnitude.
+//
+// Exposed via ctypes (gelly_tpu/utils/native.py); no pybind dependency.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Find with path halving: keeps trees near-flat without recursion.
+inline int32_t find_root(int32_t* p, int32_t x) {
+  while (p[x] != x) {
+    p[x] = p[p[x]];
+    x = p[x];
+  }
+  return x;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Union-find over one chunk's valid edges.
+//
+//   labels[v] = component root slot for every vertex touched by the chunk,
+//   labels[v] = -1 for untouched slots.
+//
+// Roots are canonicalized to the minimum slot in the chunk-local component,
+// matching the device kernel's min-root convention
+// (gelly_tpu/ops/unionfind.py) so the downstream union of (v, labels[v])
+// star edges is already near-flat.
+//
+// Returns 0 on success, 2 if any valid edge has a slot outside [0, n_v).
+int cc_chunk_combine(const int32_t* src, const int32_t* dst,
+                     const uint8_t* valid, int64_t n, int32_t n_v,
+                     int32_t* labels) {
+  // labels doubles as the parent array during the pass.
+  std::memset(labels, 0xff, sizeof(int32_t) * static_cast<size_t>(n_v));
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) continue;
+    const int32_t u = src[i];
+    const int32_t v = dst[i];
+    if (u < 0 || u >= n_v || v < 0 || v >= n_v) return 2;
+    if (labels[u] < 0) labels[u] = u;
+    if (labels[v] < 0) labels[v] = v;
+    const int32_t ru = find_root(labels, u);
+    const int32_t rv = find_root(labels, v);
+    if (ru != rv) {
+      // Union by min root: canonical representative = min slot.
+      if (ru < rv) {
+        labels[rv] = ru;
+      } else {
+        labels[ru] = rv;
+      }
+    }
+  }
+  // Flatten: every touched vertex points directly at its root.
+  for (int32_t v = 0; v < n_v; ++v) {
+    if (labels[v] >= 0) labels[v] = find_root(labels, v);
+  }
+  return 0;
+}
+
+// Parity (bipartiteness) variant: same spanning-forest compression but each
+// vertex also carries the XOR parity of its path to the root — enough to
+// reconstruct the 2-coloring constraints of the chunk (the Candidates sign
+// logic, .../summaries/Candidates.java:61-74). An odd cycle inside the chunk
+// sets *conflict to 1 (the chunk alone is non-bipartite).
+//
+//   labels[v]  = root slot or -1
+//   parity[v]  = path parity to root (0/1), valid where labels[v] >= 0
+int parity_chunk_combine(const int32_t* src, const int32_t* dst,
+                         const uint8_t* valid, int64_t n, int32_t n_v,
+                         int32_t* labels, uint8_t* parity,
+                         int32_t* conflict) {
+  std::memset(labels, 0xff, sizeof(int32_t) * static_cast<size_t>(n_v));
+  std::memset(parity, 0, static_cast<size_t>(n_v));
+  *conflict = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) continue;
+    const int32_t u = src[i];
+    const int32_t v = dst[i];
+    if (u < 0 || u >= n_v || v < 0 || v >= n_v) return 2;
+    if (labels[u] < 0) { labels[u] = u; parity[u] = 0; }
+    if (labels[v] < 0) { labels[v] = v; parity[v] = 0; }
+    // find with parity accumulation (no halving: parity bookkeeping first).
+    int32_t ru = u; uint8_t pu = 0;
+    while (labels[ru] != ru) { pu ^= parity[ru]; ru = labels[ru]; }
+    int32_t rv = v; uint8_t pv = 0;
+    while (labels[rv] != rv) { pv ^= parity[rv]; rv = labels[rv]; }
+    if (ru == rv) {
+      if (pu == pv) *conflict = 1;  // odd cycle
+      continue;
+    }
+    if (ru < rv) {
+      labels[rv] = ru;
+      parity[rv] = static_cast<uint8_t>(pu ^ pv ^ 1);
+    } else {
+      labels[ru] = rv;
+      parity[ru] = static_cast<uint8_t>(pu ^ pv ^ 1);
+    }
+  }
+  // Flatten labels and parities together (two passes of pointer chase are
+  // bounded by tree height; height is small after union-by-min + the
+  // root-ward writes above, and this pass fully flattens).
+  for (int32_t v = 0; v < n_v; ++v) {
+    if (labels[v] < 0) continue;
+    int32_t r = v; uint8_t p = 0;
+    while (labels[r] != r) { p ^= parity[r]; r = labels[r]; }
+    labels[v] = r;
+    parity[v] = p;
+  }
+  return 0;
+}
+
+}  // extern "C"
