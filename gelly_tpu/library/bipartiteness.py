@@ -29,13 +29,58 @@ class BipartiteSummary(NamedTuple):
     seen: jax.Array  # bool[N]
 
 
+def parity_labels_numpy(src: np.ndarray, dst: np.ndarray,
+                        valid: np.ndarray | None, n_v: int):
+    """Pure-numpy fallback for the native parity combiner.
+
+    Returns ``(labels i32[n_v], parity u8[n_v], conflict bool)``: the
+    chunk's spanning forest plus each touched vertex's 2-coloring parity
+    relative to its root, and whether the chunk alone contains an odd
+    cycle. Parity follows original graph edges (propagated from the roots),
+    not the compressed star — path parity is a graph property.
+    """
+    from .connected_components import cc_labels_numpy
+
+    if valid is not None:
+        m = np.asarray(valid, bool)
+        src, dst = np.asarray(src)[m], np.asarray(dst)[m]
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    labels = cc_labels_numpy(src, dst, None, n_v)
+    parity = np.zeros((n_v,), np.uint8)
+    if src.size == 0:
+        return labels, parity, False
+    known = labels == np.arange(n_v)  # roots seed color 0
+    # BFS-style relaxation over the chunk's edges; each round extends the
+    # colored frontier by one hop. Any valid per-chunk 2-coloring works
+    # (global consistency is the device merge's job), and for a bipartite
+    # chunk the propagated coloring is the unique one per component.
+    for _ in range(n_v):
+        fwd = known[src] & ~known[dst]
+        bwd = known[dst] & ~known[src]
+        if not (fwd.any() or bwd.any()):
+            break
+        parity[dst[fwd]] = parity[src[fwd]] ^ 1
+        known[dst[fwd]] = True
+        parity[src[bwd]] = parity[dst[bwd]] ^ 1
+        known[src[bwd]] = True
+    conflict = bool((parity[src] == parity[dst]).any())
+    return labels, parity, conflict
+
+
 class BipartitenessResult(NamedTuple):
     ok: jax.Array  # bool[] — graph (still) 2-colorable
     labels: jax.Array  # i32[N] component label (min slot), -1 unseen
     colors: jax.Array  # i32[N] 0/1 parity color, -1 unseen
 
 
-def bipartiteness_check(vertex_capacity: int) -> SummaryAggregation:
+def bipartiteness_check(vertex_capacity: int,
+                        ingest_combine: bool = True) -> SummaryAggregation:
+    """``ingest_combine`` (default on) attaches the ingest codec: chunks are
+    pre-reduced on the host to (spanning forest, parity, conflict) — the
+    native parity union-find combiner (native/chunk_combiner.cc) — and the
+    device unions the parity-carrying star constraints. Same H2D compression
+    rationale as the CC codec."""
     n = vertex_capacity
 
     def init() -> BipartiteSummary:
@@ -70,12 +115,53 @@ def bipartiteness_check(vertex_capacity: int) -> SummaryAggregation:
         labels, colors = puf.two_coloring(s.forest, s.seen)
         return BipartitenessResult(~s.forest.failed, labels, colors)
 
+    def host_compress(chunk):
+        from .connected_components import _native_ok
+
+        if _native_ok():
+            from ..utils.native import parity_chunk_combine
+
+            labels, parity, conflict = parity_chunk_combine(
+                np.asarray(chunk.src), np.asarray(chunk.dst),
+                np.asarray(chunk.valid), n,
+            )
+        else:
+            labels, parity, conflict = parity_labels_numpy(
+                chunk.src, chunk.dst, chunk.valid, n
+            )
+        return {
+            "labels": labels,
+            "parity": parity.astype(np.int8),
+            "conflict": np.bool_(conflict),
+        }
+
+    def fold_compressed(s: BipartiteSummary, payload) -> BipartiteSummary:
+        # payload leaves are [K, n]-stacked chunk forests (+[K] conflicts).
+        labels = payload["labels"]
+        k = labels.shape[0]
+        present = jnp.any(labels >= 0, axis=0)
+        v = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32), (k, n)
+        ).reshape(-1)
+        lab = labels.reshape(-1)
+        ok = lab >= 0
+        q = payload["parity"].reshape(-1).astype(jnp.int32)
+        forest = puf.union_edges_parity(
+            s.forest._replace(
+                failed=s.forest.failed | jnp.any(payload["conflict"])
+            ),
+            v, jnp.where(ok, lab, 0).astype(jnp.int32), q, ok,
+        )
+        return BipartiteSummary(forest, s.seen | present)
+
     return SummaryAggregation(
         init=init,
         fold=fold,
         combine=combine,
         transform=transform,
         merge_stacked=merge_stacked,
+        host_compress=host_compress if ingest_combine else None,
+        fold_compressed=fold_compressed if ingest_combine else None,
         name="bipartiteness-check",
     )
 

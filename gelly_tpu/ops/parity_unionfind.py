@@ -65,23 +65,35 @@ def union_edges_parity(f: ParityForest, u: jax.Array, v: jax.Array,
     """
 
     def body(state):
+        # Shiloach-Vishkin shape (see ops/unionfind.union_edges): hook + ONE
+        # parity-carrying doubling step per round, ~log rounds total. The
+        # invariant rel[i] = parity(i -> parent[i]) holds at every round
+        # (links are written with the edge-implied parity; doubling XORs
+        # along the composed hop), so the conflict check is sound on
+        # partially-compressed parents.
         p, r, failed, _ = state
-        p, r = pointer_jump_parity(p, r)
-        ru, rv = p[u], p[v]
-        # Required parity between the two roots for this edge to hold.
+        lu, lv = p[u], p[v]
+        # Required parity between the two parent labels for this edge.
         link_q = r[u] ^ r[v] ^ q
-        same = ru == rv
+        same = lu == lv
         failed = failed | jnp.any(valid & same & (link_q == 1))
         live = valid & ~same
-        lo = jnp.minimum(ru, rv)
-        hi = jnp.maximum(ru, rv)
+        lo = jnp.minimum(lu, lv)
+        hi = jnp.maximum(lu, lv)
         # Pack (parent, parity) so both update atomically under scatter-min;
         # ties on the same (hi, lo) pair with opposite parity resolve to one
-        # link now and surface as a same-root conflict next iteration.
+        # link now and surface as a same-parent conflict in a later round.
         packed = p * 2 + r
         packed2 = masked_scatter_min(packed, hi, lo * 2 + link_q, live)
         p2, r2 = packed2 >> 1, packed2 & 1
-        return p2, r2, failed, jnp.any(p2 != p)
+        p3 = p2[p2]
+        r3 = r2 ^ r2[p2]
+        # Exit only when BOTH parent and parity fields are stable: the last
+        # round then re-evaluated every edge against the settled coloring,
+        # so no odd cycle escapes detection. (Parents stabilize first —
+        # they're monotone non-increasing — and parity settles within one
+        # extra round once the forest is flat, since rel[root] = 0.)
+        return p3, r3, failed, jnp.any((p3 != p) | (r3 != r))
 
     def cond(state):
         return state[3]
