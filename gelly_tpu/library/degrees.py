@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core.chunk import EdgeChunk
 from ..ops import segments
 from ..parallel import mesh as mesh_lib, partition
 from ..parallel.mesh import SHARD_AXIS
@@ -85,18 +86,33 @@ class ShardedDegrees:
     """Vertex-hash-partitioned degree state over the mesh — the ``keyBy``
     parallelism strategy (SURVEY.md §2.8 row 2: the reference co-locates a
     vertex's edges on one subtask via hash shuffle,
-    ``M/SimpleEdgeStream.java:492``). Here the degree array is
-    range-partitioned over the shard axis; each device sees the whole
-    (small) chunk broadcast over ICI and scatter-adds only the endpoints it
-    owns — broadcast-then-mask instead of a ragged all_to_all, so the
-    per-device state is a dense slice and no reshuffle buffer is needed.
+    ``M/SimpleEdgeStream.java:492``).
+
+    Two modes:
+
+    - ``mode="exchange"`` (default): the chunk is split evenly across
+      devices; each device emits (endpoint, ±1) pairs for its slice and a
+      single ``all_to_all`` (:func:`parallel.partition.repartition_by_key`)
+      delivers every pair to the device owning that vertex — per-device
+      work is O(E/S), the true keyBy shuffle. Bucket overflow is counted
+      in ``self.stats["dropped"]`` and raises at the end if nonzero (raise
+      ``bucket_slack`` for skewed streams).
+    - ``mode="broadcast"``: every device scans the whole replicated chunk
+      and masks to its owned endpoints — zero exchange buffers, but
+      per-device work stays O(E). Kept as the skew-proof fallback.
     """
 
-    def __init__(self, stream, mesh=None, count_out=True, count_in=True):
+    def __init__(self, stream, mesh=None, count_out=True, count_in=True,
+                 mode: str = "exchange", bucket_slack: float = 2.0):
+        if mode not in ("exchange", "broadcast"):
+            raise ValueError(f"mode must be exchange/broadcast, got {mode}")
         self.stream = stream
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.count_out = count_out
         self.count_in = count_in
+        self.mode = mode
+        self.bucket_slack = bucket_slack
+        self.stats = {"dropped": 0}
         n = stream.ctx.vertex_capacity
         self.per_shard = partition.slots_per_shard(
             n, mesh_lib.num_shards(self.mesh)
@@ -106,30 +122,69 @@ class ShardedDegrees:
         per = self.per_shard
         count_out, count_in = self.count_out, self.count_in
         m = self.mesh
+        S = mesh_lib.num_shards(m)
         sharded = NamedSharding(m, P(SHARD_AXIS))
 
-        def body(deg_local, chunk):
-            # deg_local: this device's [per] slice; chunk replicated.
-            delta = jnp.where(chunk.event == 1, -1, 1).astype(jnp.int64)
-            if count_out:
-                mine = partition.owned_mask(chunk.src, per)
-                deg_local = segments.masked_scatter_add(
-                    deg_local, partition.to_local_slot(chunk.src, per),
-                    delta, chunk.valid & mine,
-                )
-            if count_in:
-                mine = partition.owned_mask(chunk.dst, per)
-                deg_local = segments.masked_scatter_add(
-                    deg_local, partition.to_local_slot(chunk.dst, per),
-                    delta, chunk.valid & mine,
-                )
-            return deg_local
+        if self.mode == "broadcast":
+            def body(deg_local, chunk):
+                # deg_local: this device's [per] slice; chunk replicated.
+                delta = jnp.where(chunk.event == 1, -1, 1).astype(jnp.int64)
+                if count_out:
+                    mine = partition.owned_mask(chunk.src, S)
+                    deg_local = segments.masked_scatter_add(
+                        deg_local, partition.to_local_slot(chunk.src, S),
+                        delta, chunk.valid & mine,
+                    )
+                if count_in:
+                    mine = partition.owned_mask(chunk.dst, S)
+                    deg_local = segments.masked_scatter_add(
+                        deg_local, partition.to_local_slot(chunk.dst, S),
+                        delta, chunk.valid & mine,
+                    )
+                return deg_local, jnp.zeros((1,), jnp.int64)
 
-        @partial(jax.jit, out_shardings=sharded)
+            in_chunk_spec = P()
+        else:
+            def body(deg_local, chunk_slice):
+                # chunk_slice: this device's [1, L] slice of the split chunk.
+                c = EdgeChunk(*(x[0] for x in chunk_slice))
+                delta = jnp.where(c.event == 1, -1, 1).astype(jnp.int64)
+                keys, deltas, valids = [], [], []
+                if count_out:
+                    keys.append(c.src)
+                    deltas.append(delta)
+                    valids.append(c.valid)
+                if count_in:
+                    keys.append(c.dst)
+                    deltas.append(delta)
+                    valids.append(c.valid)
+                key = jnp.concatenate(keys)
+                dd = jnp.concatenate(deltas)
+                vv = jnp.concatenate(valids)
+                cap = partition.default_bucket_capacity(
+                    key.shape[0], S, self.bucket_slack
+                )
+                key_r, dd_r, valid_r, dropped = partition.repartition_by_key(
+                    key, dd, vv, S, cap
+                )
+                deg_local = segments.masked_scatter_add(
+                    deg_local, partition.to_local_slot(key_r, S),
+                    dd_r, valid_r,
+                )
+                return deg_local, dropped.astype(jnp.int64)[None]
+
+            in_chunk_spec = P(SHARD_AXIS)
+
+        @partial(jax.jit, out_shardings=(sharded, None))
         def step(deg, chunk):
-            return mesh_lib.shard_map_fn(
-                m, body, in_specs=(P(SHARD_AXIS), P()), out_specs=P(SHARD_AXIS),
+            if self.mode == "exchange":
+                chunk = partition.split_chunk(chunk, S)
+            deg2, dropped = mesh_lib.shard_map_fn(
+                m, body, in_specs=(P(SHARD_AXIS), in_chunk_spec),
+                out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
             )(deg, chunk)
+            # dropped is identical on every shard (psum); take shard 0.
+            return deg2, dropped[0]
 
         return step
 
@@ -140,7 +195,18 @@ class ShardedDegrees:
             jnp.zeros((n,), jnp.int64), NamedSharding(self.mesh, P(SHARD_AXIS))
         )
         seen = np.zeros((n,), bool)
-        for c in self.stream:
+        dropped_dev = []
+
+        def check_drops():
+            self.stats["dropped"] = int(sum(int(d) for d in dropped_dev))
+            if self.stats["dropped"]:
+                raise ValueError(
+                    f"{self.stats['dropped']} endpoint updates overflowed "
+                    f"the exchange buckets; raise bucket_slack (no silent "
+                    f"drops)"
+                )
+
+        for i, c in enumerate(self.stream):
             ok = np.asarray(c.valid)
             # Directional parity with DegreeStream: an endpoint is
             # "touched" only for the directions being counted
@@ -149,14 +215,23 @@ class ShardedDegrees:
                 seen[np.asarray(c.src)[ok]] = True
             if self.count_in:
                 seen[np.asarray(c.dst)[ok]] = True
-            deg = step(deg, c)
-        out = np.asarray(deg)
+            deg, dropped = step(deg, c)
+            dropped_dev.append(dropped)
+            # Fail fast on long streams: one cheap host sync every 32
+            # chunks instead of discovering drops at end-of-stream.
+            if i % 32 == 31:
+                check_drops()
+        check_drops()
+        # De-stripe the shard-concatenated state back to global slot order.
+        out = partition.unstripe(np.asarray(deg), mesh_lib.num_shards(self.mesh))
         ctx = self.stream.ctx
         slots = np.nonzero(seen)[0]
         raw = ctx.decode(slots)
         return {int(r): int(out[s]) for s, r in zip(slots, raw)}
 
 
-def sharded_degrees(stream, mesh=None, count_out=True, count_in=True
+def sharded_degrees(stream, mesh=None, count_out=True, count_in=True,
+                    mode: str = "exchange", bucket_slack: float = 2.0
                     ) -> ShardedDegrees:
-    return ShardedDegrees(stream, mesh, count_out, count_in)
+    return ShardedDegrees(stream, mesh, count_out, count_in, mode,
+                          bucket_slack)

@@ -1,6 +1,6 @@
 """Edge partitioning across the mesh — the ``keyBy`` / ``PartitionMapper`` analog.
 
-Two modes mirror the reference's two shuffle patterns (SURVEY.md §2.8):
+Three modes mirror the reference's shuffle patterns (SURVEY.md §2.8):
 
 1. **Edge data-parallel** (:func:`split_chunk`): the chunk is sliced evenly
    across shards, each device folding its slice into a full-vertex-space local
@@ -8,13 +8,20 @@ Two modes mirror the reference's two shuffle patterns (SURVEY.md §2.8):
    (``SummaryBulkAggregation.PartitionMapper``, ``:93-106``). No communication;
    the merge happens later via collectives.
 
-2. **Vertex-hash partition** (:func:`owned_mask` inside ``shard_map``): state
-   is range-partitioned over vertex slots, and each device processes only the
-   edges whose group vertex it owns — the ``keyBy(0)`` shuffle. Realized as
-   broadcast-then-mask: the (small) chunk is visible to all devices and each
-   masks to its owned keys, trading a little redundant decode for zero ragged
-   all_to_all plumbing. The contiguous range partition keeps each device's
-   vertex state a dense slice (slot // slots_per_shard == shard).
+2. **Vertex-hash exchange** (:func:`repartition_by_key` inside ``shard_map``):
+   the real ``keyBy(0)`` shuffle (``M/SimpleEdgeStream.java:492``,
+   ``M/example/DegreeDistribution.java:56-58``). Each device buckets its
+   slice of the chunk by owner shard and a single ``all_to_all`` over ICI
+   delivers every entry to the device owning its key — per-device work is
+   O(E/S) and per-device state is a dense slice of the vertex space.
+   Buckets have a static capacity (ragged reality over a fixed-shape
+   exchange); overflow is *counted*, never silent, and the caller sizes
+   buckets by expected skew.
+
+3. **Broadcast-then-mask** (:func:`owned_mask` inside ``shard_map``): the
+   zero-buffer fallback — every device sees the whole chunk and masks to its
+   owned keys. Per-device work stays O(E), so it only demonstrates ownership
+   masking; prefer the exchange.
 """
 
 from __future__ import annotations
@@ -57,18 +64,98 @@ def slots_per_shard(vertex_capacity: int, num_shards: int) -> int:
     return vertex_capacity // num_shards
 
 
-def owner_of(slots: jax.Array, per_shard: int) -> jax.Array:
-    """Shard index owning each vertex slot (contiguous range partition)."""
-    return slots // per_shard
+def owner_of(slots: jax.Array, num_shards: int) -> jax.Array:
+    """Shard index owning each vertex slot.
+
+    STRIPED partition (slot % S): vertex tables assign slots sequentially,
+    so a contiguous range partition would send every early-stream vertex to
+    shard 0; striping spreads dense slot prefixes evenly. Use
+    :func:`to_local_slot` for the offset inside the owner's state slice."""
+    return slots % num_shards
 
 
-def owned_mask(slots: jax.Array, per_shard: int,
+def owned_mask(slots: jax.Array, num_shards: int,
                axis_name: str = SHARD_AXIS) -> jax.Array:
     """Inside shard_map: mask of entries whose key this device owns."""
     me = jax.lax.axis_index(axis_name)
-    return owner_of(slots, per_shard) == me
+    return owner_of(slots, num_shards) == me
 
 
-def to_local_slot(slots: jax.Array, per_shard: int) -> jax.Array:
+def to_local_slot(slots: jax.Array, num_shards: int) -> jax.Array:
     """Global slot -> offset within the owning device's state slice."""
-    return slots % per_shard
+    return slots // num_shards
+
+
+def unstripe(flat: "jax.Array | 'np.ndarray'", num_shards: int):
+    """Reorder a [S*per] shard-concatenated striped state array back to
+    global slot order: result[s] = flat[(s % S) * per + s // S]."""
+    per = flat.shape[0] // num_shards
+    return flat.reshape((num_shards, per) + flat.shape[1:]).swapaxes(0, 1) \
+        .reshape(flat.shape)
+
+
+def default_bucket_capacity(local_len: int, num_shards: int,
+                            slack: float = 2.0) -> int:
+    """Static per-destination bucket size: ``slack`` x the fair share of a
+    device's local entries, floored so tiny exchanges are always safe
+    (worst case needs ``local_len``). Raise ``slack`` for skewed key
+    distributions; broadcast-then-mask is the skew-proof fallback."""
+    fair = int(-(-local_len * slack // num_shards))
+    return min(local_len, max(64, fair))
+
+
+def repartition_by_key(key: jax.Array, payload, valid: jax.Array,
+                       num_shards: int,
+                       bucket_capacity: int,
+                       axis_name: str = SHARD_AXIS):
+    """The keyBy shuffle: all_to_all entries to the shard owning their key.
+
+    Must be called inside ``shard_map`` over ``axis_name``. ``key`` is
+    i32[L] vertex slots (striped partition, :func:`owner_of`); ``payload``
+    any pytree of [L, ...] leaves riding along; ``valid`` bool[L].
+
+    Returns ``(key', payload', valid', dropped)`` with leading dim
+    ``num_shards * bucket_capacity``: every valid received entry is owned by
+    the calling device. ``dropped`` is the *global* (psum) count of entries
+    that overflowed their destination bucket — callers must surface it
+    (observability discipline: no silent drops).
+    """
+    L = key.shape[0]
+    # Sort local entries by destination shard (invalid entries last).
+    owner = jnp.where(valid, owner_of(key, num_shards), num_shards)
+    order = jnp.argsort(owner, stable=True)
+    owner_s = owner[order]
+    # Rank of each entry within its destination group.
+    starts = jnp.searchsorted(owner_s, jnp.arange(num_shards, dtype=owner_s.dtype))
+    rank = jnp.arange(L) - starts[jnp.clip(owner_s, 0, num_shards - 1)]
+    live = (owner_s < num_shards) & (rank < bucket_capacity)
+    dropped = jax.lax.psum(
+        jnp.sum((owner_s < num_shards) & (rank >= bucket_capacity)), axis_name
+    )
+    flat = num_shards * bucket_capacity
+    # Dead entries target index ``flat`` so mode="drop" discards them
+    # (in-range fallbacks would clobber slot 0).
+    dest = jnp.where(live, owner_s * bucket_capacity + rank, flat)
+
+    def scatter(x_sorted, fill):
+        out = jnp.full((flat,) + x_sorted.shape[1:], fill, x_sorted.dtype)
+        return out.at[dest].set(x_sorted, mode="drop")
+
+    key_b = scatter(key[order], 0)
+    valid_b = jnp.zeros((flat,), bool).at[dest].set(True, mode="drop")
+    payload_b = jax.tree.map(lambda x: scatter(x[order], 0), payload)
+
+    def exchange(x):
+        tail = x.shape[1:]
+        y = jax.lax.all_to_all(
+            x.reshape((num_shards, bucket_capacity) + tail),
+            axis_name, split_axis=0, concat_axis=0,
+        )
+        return y.reshape((flat,) + tail)
+
+    return (
+        exchange(key_b),
+        jax.tree.map(exchange, payload_b),
+        exchange(valid_b),
+        dropped,
+    )

@@ -107,9 +107,10 @@ def test_vertex_range_partition_masks():
     slots = jnp.arange(cap, dtype=jnp.int32)
 
     def body():
-        m = owned_mask(slots, per)
+        m = owned_mask(slots, S)
         return jnp.sum(m.astype(jnp.int32))[None]
 
     counts = shard_map_fn(mesh, body, in_specs=(), out_specs=P(SHARD_AXIS))()
     assert np.asarray(counts).tolist() == [per] * S
-    assert int(to_local_slot(jnp.int32(per + 3), per)) == 3
+    # Striped ownership: slot s -> shard s % S, local offset s // S.
+    assert int(to_local_slot(jnp.int32(3 * S + 5), S)) == 3
