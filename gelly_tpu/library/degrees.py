@@ -88,24 +88,29 @@ class ShardedDegrees:
     vertex's edges on one subtask via hash shuffle,
     ``M/SimpleEdgeStream.java:492``).
 
-    Two modes:
+    Three modes:
 
-    - ``mode="exchange"`` (default): the chunk is split evenly across
-      devices; each device emits (endpoint, ±1) pairs for its slice and a
-      single ``all_to_all`` (:func:`parallel.partition.repartition_by_key`)
+    - ``mode="auto"`` (default): the keyed exchange below, but a chunk
+      whose exchange buckets overflow is left unapplied and replayed
+      through the broadcast step — skewed streams stay correct at
+      broadcast cost for the hot chunks only
+      (``self.stats["fallback_chunks"]`` counts them).
+    - ``mode="exchange"``: the chunk is split evenly across devices; each
+      device emits (endpoint, ±1) pairs for its slice and a single
+      ``all_to_all`` (:func:`parallel.partition.repartition_by_key`)
       delivers every pair to the device owning that vertex — per-device
       work is O(E/S), the true keyBy shuffle. Bucket overflow is counted
-      in ``self.stats["dropped"]`` and raises at the end if nonzero (raise
+      in ``self.stats["dropped"]`` and raises (strict mode; raise
       ``bucket_slack`` for skewed streams).
     - ``mode="broadcast"``: every device scans the whole replicated chunk
       and masks to its owned endpoints — zero exchange buffers, but
-      per-device work stays O(E). Kept as the skew-proof fallback.
+      per-device work stays O(E). The skew-proof fallback.
     """
 
     def __init__(self, stream, mesh=None, count_out=True, count_in=True,
-                 mode: str = "exchange", bucket_slack: float = 2.0):
-        if mode not in ("exchange", "broadcast"):
-            raise ValueError(f"mode must be exchange/broadcast, got {mode}")
+                 mode: str = "auto", bucket_slack: float = 2.0):
+        if mode not in ("auto", "exchange", "broadcast"):
+            raise ValueError(f"mode must be auto/exchange/broadcast, got {mode}")
         self.stream = stream
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.count_out = count_out
@@ -118,14 +123,13 @@ class ShardedDegrees:
             n, mesh_lib.num_shards(self.mesh)
         )
 
-    def _step_fn(self):
-        per = self.per_shard
+    def _step_fn(self, mode: str):
         count_out, count_in = self.count_out, self.count_in
         m = self.mesh
         S = mesh_lib.num_shards(m)
         sharded = NamedSharding(m, P(SHARD_AXIS))
 
-        if self.mode == "broadcast":
+        if mode == "broadcast":
             def body(deg_local, chunk):
                 # deg_local: this device's [per] slice; chunk replicated.
                 delta = jnp.where(chunk.event == 1, -1, 1).astype(jnp.int64)
@@ -167,17 +171,22 @@ class ShardedDegrees:
                 key_r, dd_r, valid_r, dropped = partition.repartition_by_key(
                     key, dd, vv, S, cap
                 )
-                deg_local = segments.masked_scatter_add(
+                applied = segments.masked_scatter_add(
                     deg_local, partition.to_local_slot(key_r, S),
                     dd_r, valid_r,
                 )
+                # An overflowing chunk is left UNAPPLIED (dropped is the
+                # same psum on every device, so all shards agree): auto
+                # mode replays it through the broadcast step; strict mode
+                # raises with the state still consistent.
+                deg_local = jnp.where(dropped == 0, applied, deg_local)
                 return deg_local, dropped.astype(jnp.int64)[None]
 
             in_chunk_spec = P(SHARD_AXIS)
 
         @partial(jax.jit, out_shardings=(sharded, None))
         def step(deg, chunk):
-            if self.mode == "exchange":
+            if mode != "broadcast":
                 chunk = partition.split_chunk(chunk, S)
             deg2, dropped = mesh_lib.shard_map_fn(
                 m, body, in_specs=(P(SHARD_AXIS), in_chunk_spec),
@@ -190,20 +199,37 @@ class ShardedDegrees:
 
     def final_degrees(self) -> dict[int, int]:
         n = self.stream.ctx.vertex_capacity
-        step = self._step_fn()
+        mode = self.mode
+        step = self._step_fn("broadcast" if mode == "broadcast" else "exchange")
+        fallback = self._step_fn("broadcast") if mode == "auto" else None
         deg = jax.device_put(
             jnp.zeros((n,), jnp.int64), NamedSharding(self.mesh, P(SHARD_AXIS))
         )
         seen = np.zeros((n,), bool)
-        dropped_dev = []
+        pending: list = []  # (chunk, dropped_scalar) awaiting the drop check
+        self.stats["fallback_chunks"] = 0
 
         def check_drops():
-            self.stats["dropped"] = int(sum(int(d) for d in dropped_dev))
-            if self.stats["dropped"]:
+            nonlocal deg
+            dropped_total = 0
+            for c, d in pending:
+                nd = int(d)
+                if not nd:
+                    continue
+                if fallback is not None:
+                    # The overflowing chunk was left unapplied: replay it
+                    # through the skew-proof broadcast step.
+                    deg, _ = fallback(deg, c)
+                    self.stats["fallback_chunks"] += 1
+                else:
+                    dropped_total += nd
+            pending.clear()
+            if dropped_total:
+                self.stats["dropped"] += dropped_total
                 raise ValueError(
-                    f"{self.stats['dropped']} endpoint updates overflowed "
-                    f"the exchange buckets; raise bucket_slack (no silent "
-                    f"drops)"
+                    f"{dropped_total} endpoint updates overflowed the "
+                    f"exchange buckets; raise bucket_slack or use "
+                    f"mode='auto' (no silent drops)"
                 )
 
         for i, c in enumerate(self.stream):
@@ -216,11 +242,13 @@ class ShardedDegrees:
             if self.count_in:
                 seen[np.asarray(c.dst)[ok]] = True
             deg, dropped = step(deg, c)
-            dropped_dev.append(dropped)
-            # Fail fast on long streams: one cheap host sync every 32
-            # chunks instead of discovering drops at end-of-stream.
-            if i % 32 == 31:
-                check_drops()
+            if mode != "broadcast":
+                pending.append((c, dropped))
+                # One host sync every 8 chunks: fail fast (strict) or
+                # replay overflowed chunks (auto) without serializing the
+                # dispatch pipeline.
+                if i % 8 == 7:
+                    check_drops()
         check_drops()
         # De-stripe the shard-concatenated state back to global slot order.
         out = partition.unstripe(np.asarray(deg), mesh_lib.num_shards(self.mesh))
@@ -231,7 +259,7 @@ class ShardedDegrees:
 
 
 def sharded_degrees(stream, mesh=None, count_out=True, count_in=True,
-                    mode: str = "exchange", bucket_slack: float = 2.0
+                    mode: str = "auto", bucket_slack: float = 2.0
                     ) -> ShardedDegrees:
     return ShardedDegrees(stream, mesh, count_out, count_in, mode,
                           bucket_slack)

@@ -71,9 +71,8 @@ class ShardedSnapshotStream:
         self.S = mesh_lib.num_shards(self.mesh)
         self.bucket_slack = bucket_slack
         self.window_capacity = window_capacity
-        self.per_shard = partition.slots_per_shard(
-            stream.ctx.vertex_capacity, self.S
-        )
+        # Validates divisibility of the vertex space by the mesh.
+        partition.slots_per_shard(stream.ctx.vertex_capacity, self.S)
         self.stats = {"late_edges": 0, "windows_closed": 0, "dropped": 0}
 
     # -------------------------------------------------------------- #

@@ -115,6 +115,33 @@ def test_sharded_degrees_modes_agree(mesh):
     assert a == b
 
 
+def test_sharded_degrees_auto_fallback_on_skew(mesh):
+    # Star graph: every endpoint buckets to vertex 0's owner. Auto mode
+    # must replay overflowed chunks via broadcast and stay correct.
+    from gelly_tpu.library.degrees import sharded_degrees
+
+    # Chunks large enough that the per-destination bucket (floor 64) is
+    # smaller than one device's worst-case fan-in to vertex 0's owner.
+    n = 2048
+    src = np.zeros(n, np.int64)
+    dst = (np.arange(n) % (N_V - 1) + 1).astype(np.int64)
+    sd = sharded_degrees(_stream(src, dst, chunk_size=1024), mesh=mesh,
+                         mode="auto", bucket_slack=1.0)
+    got = sd.final_degrees()
+    want: dict[int, int] = {}
+    for u, v in zip(src.tolist(), dst.tolist()):
+        want[u] = want.get(u, 0) + 1
+        want[v] = want.get(v, 0) + 1
+    assert got == want
+    assert sd.stats["fallback_chunks"] > 0
+
+    # Strict mode on the same stream raises instead.
+    sd2 = sharded_degrees(_stream(src, dst, chunk_size=1024), mesh=mesh,
+                          mode="exchange", bucket_slack=1.0)
+    with pytest.raises(ValueError, match="overflowed"):
+        sd2.final_degrees()
+
+
 def test_sharded_window_reduce_matches_single_device(mesh):
     rng = np.random.default_rng(3)
     n = 400
