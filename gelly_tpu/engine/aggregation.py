@@ -86,6 +86,11 @@ class SummaryAggregation:
     # per-edge timestamps).
     host_compress: Callable[[EdgeChunk], Any] | None = None
     fold_compressed: Callable[[Summary, Any], Summary] | None = None
+    # SummaryTreeReduce's degree knob (M/SummaryTreeReduce.java:75): when
+    # set, the cross-shard combine runs as a two-phase hierarchical tree —
+    # groups of S/degree shards merge first (ICI-local), then across groups
+    # (DCN on multi-host meshes). None = flat butterfly / gather merge.
+    merge_degree: int | None = None
     name: str = "aggregation"
 
 
@@ -213,7 +218,11 @@ def _compiled_plan(agg: SummaryAggregation, m):
         def merge_locals(locals_):
             def body(loc):
                 s = unshard_leaf(loc)
-                if agg.merge_stacked is not None:
+                if agg.merge_degree is not None:
+                    g = collectives.hierarchical_merge(
+                        agg.combine, s, S, min(agg.merge_degree, S)
+                    )
+                elif agg.merge_stacked is not None:
                     g = collectives.gather_merge(agg.merge_stacked, s)
                 else:
                     g = collectives.butterfly_merge(agg.combine, s, S)
@@ -325,6 +334,12 @@ def run_aggregation(
         raise ValueError("pass at most one of merge_every / window_ms")
     if merge_every is None and window_ms is None:
         merge_every = 1
+    if agg.merge_degree is not None:
+        d = agg.merge_degree
+        if d <= 0 or (d & (d - 1)):
+            raise ValueError(
+                f"merge_degree must be a positive power of two, got {d}"
+            )
 
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)

@@ -163,9 +163,17 @@ def connected_components(
     )
 
 
-def connected_components_tree(vertex_capacity: int) -> SummaryAggregation:
-    """ConnectedComponentsTree parity alias (merge-tree combine)."""
-    return connected_components(vertex_capacity, merge="tree")
+def connected_components_tree(vertex_capacity: int,
+                              degree: int | None = None) -> SummaryAggregation:
+    """ConnectedComponentsTree parity alias (merge-tree combine).
+
+    ``degree`` is the SummaryTreeReduce partial-parallelism knob
+    (ConnectedComponentsTree.java:28-34 passing through to
+    SummaryTreeReduce.java:75): the cross-shard merge runs as a two-phase
+    hierarchical tree with ``degree`` group summaries after phase 1."""
+    agg = connected_components(vertex_capacity, merge="tree")
+    agg.merge_degree = degree
+    return agg
 
 
 def cc_host_precombine(chunk):

@@ -27,6 +27,65 @@ from ..parallel import mesh as mesh_lib, partition
 from ..parallel.mesh import SHARD_AXIS
 
 
+def degree_aggregate(vertex_capacity: int, count_out: bool = True,
+                     count_in: bool = True, ingest_combine: bool = True):
+    """Continuous degree aggregate as a SummaryAggregation — the engine
+    form of ``getDegrees`` (SimpleEdgeStream.java:413-478, BASELINE
+    workload #1): summary = dense degree vector, fold = ±1 endpoint
+    scatter, combine = elementwise add.
+
+    ``ingest_combine`` attaches the degree codec: each chunk pre-reduces on
+    the host to a dense i32 delta vector (two ``np.bincount`` calls —
+    deletions subtract), shipping N*4 bytes instead of the chunk's edges;
+    the device fold is a vector add. Same H2D rationale as the CC codec.
+    """
+    from ..engine.aggregation import SummaryAggregation
+
+    n = vertex_capacity
+
+    def init():
+        return jnp.zeros((n,), jnp.int64)
+
+    def fold(deg, chunk):
+        delta = jnp.where(chunk.event == 1, -1, 1).astype(jnp.int64)
+        if count_out:
+            deg = segments.masked_scatter_add(
+                deg, chunk.src, delta, chunk.valid
+            )
+        if count_in:
+            deg = segments.masked_scatter_add(
+                deg, chunk.dst, delta, chunk.valid
+            )
+        return deg
+
+    def host_compress(chunk):
+        m = np.asarray(chunk.valid)
+        sign = np.where(np.asarray(chunk.event) == 1, -1, 1)[m]
+        out = np.zeros((n,), np.int32)
+        if count_out:
+            out += np.bincount(
+                np.asarray(chunk.src)[m], weights=sign, minlength=n
+            ).astype(np.int32)
+        if count_in:
+            out += np.bincount(
+                np.asarray(chunk.dst)[m], weights=sign, minlength=n
+            ).astype(np.int32)
+        return out
+
+    def fold_compressed(deg, deltas):  # deltas: i32[K, n]
+        return deg + jnp.sum(deltas, axis=0, dtype=jnp.int64)
+
+    return SummaryAggregation(
+        init=init,
+        fold=fold,
+        combine=lambda a, b: a + b,
+        transform=None,
+        host_compress=host_compress if ingest_combine else None,
+        fold_compressed=fold_compressed if ingest_combine else None,
+        name="degree-aggregate",
+    )
+
+
 def degree_distribution(stream, max_degree: int | None = None
                         ) -> "DegreeDistributionStream":
     return DegreeDistributionStream(stream, max_degree)

@@ -81,6 +81,19 @@ def _window_triangle_count(view: NeighborhoodView, capacity: int,
     return jnp.sum(jnp.where(uniq, per_edge, 0))
 
 
+def _check_arrival_budget(seen_host: int, chunk) -> int:
+    """Arrival indices are i32: raise before they can wrap (detect-and-
+    raise discipline — a wrapped index would silently invert the
+    closing-edge comparison)."""
+    seen_host += int(np.asarray(chunk.valid).sum())
+    if seen_host >= segments.INT_MAX - chunk.capacity:
+        raise ValueError(
+            f"arrival-index budget exhausted after {seen_host} edges "
+            f"(i32 indices); restart the summary or shard the stream"
+        )
+    return seen_host
+
+
 def _check_slot_range(capacity: int, full_capacity: int, *arrays_with_mask):
     """Raise when a live slot exceeds a narrowed adjacency capacity —
     scatters would silently drop and gathers clamp otherwise."""
@@ -264,11 +277,13 @@ class ExactTriangleStream:
     def __iter__(self) -> Iterator[TriangleCounts]:
         n = self.capacity
         state = fresh_triangle_counts(n)
+        seen_host = 0
         for c in self.stream:
             _check_slot_range(
                 n, self.stream.ctx.vertex_capacity,
                 (c.src, c.valid), (c.dst, c.valid),
             )
+            seen_host = _check_arrival_budget(seen_host, c)
             state = _exact_step(state, c)
             yield state
 
@@ -471,11 +486,13 @@ class SparseExactTriangleStream:
     def __iter__(self) -> Iterator[SparseTriangleCounts]:
         state = fresh_sparse_triangle_counts(self.capacity, self.max_degree)
         prev_overflow = None
+        seen_host = 0
         for c in self.stream:
             _check_slot_range(
                 self.capacity, self.stream.ctx.vertex_capacity,
                 (c.src, c.valid), (c.dst, c.valid),
             )
+            seen_host = _check_arrival_budget(seen_host, c)
             state = _sparse_exact_step(state, c, self.max_degree, self.slab)
             # Check the PREVIOUS chunk's overflow after dispatching the
             # current one: the host sync lands on an already-finished

@@ -23,7 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-EMPTY = jnp.int64(np.iinfo(np.int64).min)
+# numpy scalar, NOT a jnp array: a module-level device constant would
+# initialize the XLA backend at import, which breaks
+# jax.distributed.initialize (it must run before any backend touch).
+EMPTY = np.int64(np.iinfo(np.int64).min)
 
 
 class HashSetState(NamedTuple):

@@ -58,6 +58,82 @@ def butterfly_merge(combine: Callable, summary, num_shards: int,
     return summary
 
 
+def hierarchical_merge(combine: Callable, summary, num_shards: int,
+                       degree: int, axis_name: str = SHARD_AXIS):
+    """Three-phase merge tree — the ``SummaryTreeReduce`` ``degree`` knob
+    (M/SummaryTreeReduce.java:75,95-123).
+
+    - Phase 1: butterfly within aligned groups of ``num_shards // degree``
+      consecutive shards (small XOR strides — nearest ICI hops; on a
+      multi-host mesh these stay intra-host). Afterwards ``degree``
+      independent group summaries exist — the reference's
+      partial-parallelism reduction.
+    - Phase 2: *leader-only* cross-group butterfly: one shard per group
+      exchanges over the large strides, so the expensive (DCN on
+      multi-host) hops carry ``degree·log2(degree)`` messages instead of
+      the flat butterfly's ``num_shards·log2(degree)``.
+    - Phase 3: binomial broadcast of the leader's global summary back
+      through each group (ICI again).
+
+    The replicated result is identical to :func:`butterfly_merge` for any
+    associative+commutative combine; the knob changes the communication
+    *schedule*, trading phase-3 broadcast latency for far fewer cross-group
+    messages.
+
+    ``degree`` must divide ``num_shards`` and both must be powers of two.
+    ``degree == num_shards`` degenerates to the flat butterfly.
+    """
+    if num_shards <= 0 or degree <= 0:
+        raise ValueError("hierarchical_merge sizes must be positive")
+    if num_shards & (num_shards - 1) or degree & (degree - 1):
+        raise ValueError("hierarchical_merge requires power-of-two sizes")
+    if num_shards % degree:
+        raise ValueError(
+            f"degree {degree} must divide num_shards {num_shards}"
+        )
+    group = num_shards // degree
+    me = jax.lax.axis_index(axis_name)
+    rank = me % group  # position within my group
+
+    # Phase 1: intra-group butterflies (strides 1 .. group/2).
+    step = 1
+    while step < group:
+        perm = [(i, i ^ step) for i in range(num_shards)]
+        summary = combine(summary, _ppermute_tree(summary, perm, axis_name))
+        step <<= 1
+
+    # Phase 2: leader-only exchange (strides group .. num_shards/2). XOR
+    # with a multiple of ``group`` maps leaders to leaders; non-leaders
+    # receive nothing (ppermute zero-fills) and keep their summary — their
+    # interim value is discarded by phase 3 anyway.
+    is_leader = rank == 0
+    while step < num_shards:
+        perm = [(i, i ^ step) for i in range(num_shards) if i % group == 0]
+        other = _ppermute_tree(summary, perm, axis_name)
+        merged = combine(summary, other)
+        summary = jax.tree.map(
+            lambda m, s: jnp.where(is_leader, m, s), merged, summary
+        )
+        step <<= 1
+
+    # Phase 3: binomial broadcast leader -> group members, largest stride
+    # first (after the stride-st round, every rank < 2*st holds the global
+    # summary).
+    st = group >> 1
+    while st >= 1:
+        perm = [
+            (i, i + st) for i in range(num_shards)
+            if (i % group) % (2 * st) == 0 and (i % group) + st < group
+        ]
+        received = _ppermute_tree(summary, perm, axis_name)
+        is_recv = rank % (2 * st) == st
+        summary = jax.tree.map(
+            lambda r, s: jnp.where(is_recv, r, s), received, summary
+        )
+        st >>= 1
+    return summary
+
+
 def gather_merge(merge_stacked: Callable, summary, axis_name: str = SHARD_AXIS):
     """all_gather all shards' summaries and fold with ``merge_stacked``.
 

@@ -72,12 +72,19 @@ inline const char* parse_i64(const char* p, const char* end, int64_t* out) {
     ++p;
   }
   if (p >= end || *p < '0' || *p > '9') return nullptr;
-  int64_t v = 0;
+  // Unsigned magnitude accumulation: the negative range reaches one past
+  // INT64_MAX, so INT64_MIN itself must parse (python-parser parity) while
+  // anything wider reads as malformed, never wrapping to a wrong id.
+  const uint64_t limit =
+      static_cast<uint64_t>(INT64_MAX) + (neg ? 1u : 0u);
+  uint64_t v = 0;
   while (p < end && *p >= '0' && *p <= '9') {
-    v = v * 10 + (*p - '0');
+    const uint64_t digit = static_cast<uint64_t>(*p - '0');
+    if (v > (limit - digit) / 10) return nullptr;
+    v = v * 10 + digit;
     ++p;
   }
-  *out = neg ? -v : v;
+  *out = neg ? static_cast<int64_t>(0u - v) : static_cast<int64_t>(v);
   return p;
 }
 

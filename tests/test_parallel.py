@@ -114,3 +114,61 @@ def test_vertex_range_partition_masks():
     assert np.asarray(counts).tolist() == [per] * S
     # Striped ownership: slot s -> shard s % S, local offset s // S.
     assert int(to_local_slot(jnp.int32(3 * S + 5), S)) == 3
+
+
+def test_hierarchical_merge_degree_invariance():
+    # SummaryTreeReduce's degree knob: merging at degree 1/2/4/8 must give
+    # identical results (the tree shape changes, the monoid result cannot).
+    import jax
+    from gelly_tpu.parallel.collectives import (
+        butterfly_merge,
+        hierarchical_merge,
+    )
+
+    mesh = make_mesh()
+    S = num_shards(mesh)
+    rng = np.random.default_rng(0)
+    vals = rng.integers(0, 1000, (S, 16)).astype(np.int64)
+
+    def run(degree):
+        def body(x):
+            if degree is None:
+                return butterfly_merge(jnp.add, x[0], S)[None]
+            return hierarchical_merge(jnp.add, x[0], S, degree)[None]
+
+        f = shard_map_fn(mesh, body, in_specs=(P(SHARD_AXIS),),
+                         out_specs=P(SHARD_AXIS))
+        return np.asarray(jax.jit(f)(vals))
+
+    flat = run(None)
+    for degree in (1, 2, 4, 8):
+        got = run(degree)
+        np.testing.assert_array_equal(got, flat)
+        # replicated output: every shard holds the global sum
+        np.testing.assert_array_equal(got[0], vals.sum(axis=0))
+
+
+def test_cc_tree_degree_knob_parity():
+    from gelly_tpu.core.io import EdgeChunkSource
+    from gelly_tpu.core.stream import edge_stream_from_source
+    from gelly_tpu.core.vertices import IdentityVertexTable
+    from gelly_tpu.library.connected_components import (
+        connected_components_tree,
+        labels_to_components,
+    )
+
+    mesh = make_mesh()
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 64, 400).astype(np.int64)
+    dst = rng.integers(0, 64, 400).astype(np.int64)
+
+    def run(degree):
+        s = edge_stream_from_source(
+            EdgeChunkSource(src, dst, chunk_size=64,
+                            table=IdentityVertexTable(64)), 64)
+        agg = connected_components_tree(64, degree=degree)
+        labels = s.aggregate(agg, mesh=mesh, merge_every=2).result()
+        return labels_to_components(labels, s.ctx)
+
+    base = run(None)
+    assert all(run(d) == base for d in (2, 4, 8))

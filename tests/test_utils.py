@@ -139,3 +139,25 @@ def test_prefetch_early_abandon_unblocks_worker():
     _t.sleep(0.4)  # worker should notice the cancel and exit
     assert threading.active_count() <= before + 1
     assert len(produced) < 20  # source was not fully drained
+
+
+def test_native_parser_overflow_reads_as_malformed(tmp_path):
+    # An id wider than int64 must be skipped like any malformed line (the
+    # python parser raises/skips), never silently wrapped to a wrong id.
+    from gelly_tpu.utils.native import parse_edge_list_file
+
+    p = tmp_path / "ovf.txt"
+    p.write_text(
+        "1 2\n"
+        "99999999999999999999999999 3\n"
+        "4 170141183460469231731687303715884105727\n"
+        "9223372036854775807 6\n"
+        "-9223372036854775808 7\n"
+        "-9223372036854775809 8\n"
+    )
+    src, dst = parse_edge_list_file(str(p))
+    assert list(zip(src.tolist(), dst.tolist())) == [
+        (1, 2),
+        (9223372036854775807, 6),  # INT64_MAX parses
+        (-9223372036854775808, 7),  # INT64_MIN parses (one past MAX)
+    ]
