@@ -37,6 +37,28 @@ def _adj_step(adj, c: EdgeChunk, directed: bool):
     return adj
 
 
+@partial(jax.jit, static_argnames=("directed", "max_degree"))
+def _row_step(nbr, deg, over, c: EdgeChunk, directed: bool, max_degree: int):
+    """Capped-degree row-table insert with set semantics (TreeSet parity:
+    duplicates are no-ops; self-loops insert like the dense path and the
+    reference's map-of-sets). Sequential within the chunk so in-chunk
+    duplicates dedupe too."""
+    from ..ops.rowtable import row_insert
+
+    def step(carry, inp):
+        u, v, ok = inp
+        carry = row_insert(*carry, u, v, ok, max_degree)
+        if not directed:
+            # For self-loops the second direction dedupes to a no-op.
+            carry = row_insert(*carry, v, u, ok, max_degree)
+        return carry, None
+
+    (nbr, deg, over), _ = jax.lax.scan(
+        step, (nbr, deg, over), (c.src, c.dst, c.valid)
+    )
+    return nbr, deg, over
+
+
 class NeighborhoodStream:
     """Stream of growing adjacency snapshots (buildNeighborhood analog).
 
@@ -46,36 +68,78 @@ class NeighborhoodStream:
     """
 
     def __init__(self, stream, directed: bool = False,
-                 capacity: int | None = None):
+                 capacity: int | None = None,
+                 max_degree: int | None = None):
         self.stream = stream
         self.directed = directed
         self.capacity = (
             int(capacity) if capacity is not None
             else stream.ctx.vertex_capacity
         )
+        # max_degree switches to the capped-degree row table: O(N*D)
+        # memory, the N >= 1M buildNeighborhood path (the reference's
+        # TreeSet adjacency handles arbitrary N,
+        # M/summaries/AdjacencyListGraph.java:31). Degree overflow raises —
+        # never a silently truncated neighborhood.
+        self.max_degree = max_degree
 
     def __iter__(self) -> Iterator[jax.Array]:
         """Yield the adjacency snapshot after each chunk (chunk-grained
         emission; the reference emits per edge — documented deviation, final
-        state identical)."""
+        state identical). Dense mode yields bool[N, N]; sparse mode yields
+        (nbr i32[N, D], deg i32[N])."""
         n = self.capacity
-        adj = jnp.zeros((n, n), bool)
+        if self.max_degree is None:
+            adj = jnp.zeros((n, n), bool)
+            for c in self.stream:
+                self._check_range(c)
+                adj = _adj_step(adj, c, self.directed)
+                yield adj
+            return
+        nbr = jnp.full((n, self.max_degree), -1, jnp.int32)
+        deg = jnp.zeros((n,), jnp.int32)
+        over = jnp.zeros((), jnp.int32)
+        prev_over = None
         for c in self.stream:
             self._check_range(c)
-            adj = _adj_step(adj, c, self.directed)
-            yield adj
+            nbr, deg, over = _row_step(
+                nbr, deg, over, c, self.directed, self.max_degree
+            )
+            # Check the PREVIOUS chunk's overflow after dispatching this
+            # one: the host sync lands on finished work, keeping async
+            # dispatch pipelined (same pattern as the sparse triangle
+            # stream).
+            if prev_over is not None and int(prev_over):
+                raise self._overflow_error(int(prev_over))
+            prev_over = over
+            yield nbr, deg
+        if prev_over is not None and int(prev_over):
+            raise self._overflow_error(int(prev_over))
 
-    def final_adjacency(self) -> jax.Array:
+    def final_adjacency(self):
         """Drained adjacency; cached so repeated queries (neighbors_of) don't
-        re-read the stream and rebuild the N² matrix."""
+        re-read the stream and rebuild the matrix/table."""
         if getattr(self, "_final", None) is None:
             adj = None
             for adj in self:
                 pass
             if adj is None:
-                adj = jnp.zeros((self.capacity, self.capacity), bool)
+                if self.max_degree is None:
+                    adj = jnp.zeros((self.capacity, self.capacity), bool)
+                else:
+                    adj = (
+                        jnp.full((self.capacity, self.max_degree), -1,
+                                 jnp.int32),
+                        jnp.zeros((self.capacity,), jnp.int32),
+                    )
             self._final = adj
         return self._final
+
+    def _overflow_error(self, n: int) -> ValueError:
+        return ValueError(
+            f"{n} neighbor inserts exceeded max_degree {self.max_degree}; "
+            f"raise max_degree or use the dense path"
+        )
 
     def _check_range(self, c: EdgeChunk):
         # Guard against silent drop when capacity < stream vertex space.
@@ -99,6 +163,11 @@ class NeighborhoodStream:
         slot = int(ctx.table.lookup(np.array([raw_id]))[0])
         if slot < 0:
             return []
-        row = np.asarray(adj[slot])
-        nbrs = np.nonzero(row)[0]
+        if self.max_degree is None:
+            row = np.asarray(adj[slot])
+            nbrs = np.nonzero(row)[0]
+        else:
+            nbr, deg = adj
+            row = np.asarray(nbr[slot])
+            nbrs = row[: int(deg[slot])]
         return sorted(ctx.decode(nbrs).tolist())

@@ -331,14 +331,17 @@ class EdgeStream:
         return SnapshotStream(self, window_ms, direction, window_capacity)
 
     def build_neighborhood(self, directed: bool = False,
-                           capacity: int | None = None):
+                           capacity: int | None = None,
+                           max_degree: int | None = None):
         """Stream of growing adjacency snapshots
         (BuildNeighborhoods, M/SimpleEdgeStream.java:531-560). ``capacity``
         caps the N×N adjacency below the stream's vertex space (the exact
-        path's memory bound); see gelly_tpu.core.neighborhood."""
+        path's memory bound); ``max_degree`` switches to the capped-degree
+        sparse table (O(N*D) memory, the N >= 1M path); see
+        gelly_tpu.core.neighborhood."""
         from .neighborhood import NeighborhoodStream
 
-        return NeighborhoodStream(self, directed, capacity)
+        return NeighborhoodStream(self, directed, capacity, max_degree)
 
 
 class DegreeStream:

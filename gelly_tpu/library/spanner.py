@@ -81,11 +81,138 @@ def _insert_edges(summary: SpannerSummary, src, dst, valid, k: int
     return out
 
 
+# ------------------------------------------------------------------ #
+# sparse (capped-degree) spanner — the N >= 1M path
+
+
+class SparseSpannerSummary(NamedTuple):
+    nbr: jax.Array  # i32[N, D] spanner adjacency rows (-1 empty)
+    deg: jax.Array  # i32[N]
+    esrc: jax.Array  # i32[E] accepted edges, insertion order
+    edst: jax.Array  # i32[E]
+    n: jax.Array  # i32[] accepted edges
+    overflow: jax.Array  # bool[] edge-list capacity exceeded (sticky)
+    deg_overflow: jax.Array  # i32[] adjacency inserts dropped by the cap
+
+
+def _within_k_sparse(nbr, u, v, k: int, frontier_cap: int) -> jax.Array:
+    """boundedBFS over capped-degree rows with a bounded frontier set.
+
+    Conservative by construction: a frontier or degree overflow can only
+    UNDER-report reachability, which makes the spanner accept an extra
+    edge — never reject wrongly — so the k-stretch property survives every
+    capacity limit (SURVEY.md §7 hard-part #2's safe degradation).
+    """
+    n = nbr.shape[0]
+    sent = jnp.int32(n)  # sentinel sorts last under unique
+    frontier = jnp.full((frontier_cap,), sent, jnp.int32).at[0].set(u)
+
+    def body(_, f):
+        live = f < sent
+        rows = nbr[jnp.where(live, f, 0)]  # [F, D]
+        cand = jnp.where(live[:, None] & (rows >= 0), rows, sent)
+        merged = jnp.concatenate([f, cand.reshape(-1)])
+        return jnp.unique(merged, size=frontier_cap, fill_value=sent)
+
+    frontier = jax.lax.fori_loop(0, k, body, frontier)
+    return jnp.any(frontier == v)
+
+
+def _sparse_insert_edges(s: SparseSpannerSummary, src, dst, valid, k: int,
+                         max_degree: int, frontier_cap: int
+                         ) -> SparseSpannerSummary:
+    """Sequential gate-and-insert over the capped-degree table."""
+    D = max_degree
+
+    from ..ops.rowtable import row_insert
+
+    def step(s, inp):
+        u, v, ok = inp
+        live = ok & (u != v)
+        reach = _within_k_sparse(s.nbr, u, v, k, frontier_cap)
+        take = live & ~reach
+        # Row appends (u -> v and v -> u) at each row's next free slot;
+        # no dedupe needed (a duplicate edge is always within k and never
+        # taken).
+        nbr, deg, dover = s.nbr, s.deg, s.deg_overflow
+        for a, b in ((u, v), (v, u)):
+            nbr, deg, dover = row_insert(
+                nbr, deg, dover, a, b, take, D, dedupe=False
+            )
+        store = take & (s.n < s.esrc.shape[0])
+        slot = jnp.minimum(s.n, s.esrc.shape[0] - 1)
+        esrc = s.esrc.at[slot].set(jnp.where(store, u, s.esrc[slot]))
+        edst = s.edst.at[slot].set(jnp.where(store, v, s.edst[slot]))
+        overflow = s.overflow | (take & ~store)
+        return SparseSpannerSummary(
+            nbr, deg, esrc, edst, s.n + take.astype(jnp.int32), overflow,
+            dover,
+        ), None
+
+    out, _ = jax.lax.scan(step, s, (src, dst, valid))
+    return out
+
+
+def sparse_spanner(vertex_capacity: int, k: int, max_degree: int,
+                   max_edges: int | None = None,
+                   frontier_cap: int | None = None) -> SummaryAggregation:
+    """k-spanner over a capped-degree adjacency: O(N*D) memory instead of
+    the dense path's O(N^2), feasible at N >= 1M. Degree/frontier caps
+    degrade conservatively (extra accepted edges, never a broken stretch
+    bound); ``deg_overflow`` counts how often that happened."""
+    n = vertex_capacity
+    D = max_degree
+    e_cap = max_edges if max_edges is not None else 64 * 1024
+    F = frontier_cap if frontier_cap is not None else max(32, 4 * D)
+
+    def init() -> SparseSpannerSummary:
+        return SparseSpannerSummary(
+            nbr=jnp.full((n, D), -1, jnp.int32),
+            deg=jnp.zeros((n,), jnp.int32),
+            esrc=jnp.zeros((e_cap,), jnp.int32),
+            edst=jnp.zeros((e_cap,), jnp.int32),
+            n=jnp.zeros((), jnp.int32),
+            overflow=jnp.zeros((), bool),
+            deg_overflow=jnp.zeros((), jnp.int32),
+        )
+
+    def fold(s, chunk):
+        return _sparse_insert_edges(
+            s, chunk.src, chunk.dst, chunk.valid, k, D, F
+        )
+
+    def combine(a, b):
+        # Merge smaller into larger (CombineSpanners.reduce,
+        # Spanner.java:91-116), re-applying the gate edge-by-edge.
+        big = jax.tree.map(lambda x, y: jnp.where(a.n >= b.n, x, y), a, b)
+        small = jax.tree.map(lambda x, y: jnp.where(a.n >= b.n, y, x), a, b)
+        valid = jnp.arange(small.esrc.shape[0]) < small.n
+        merged = _sparse_insert_edges(
+            big, small.esrc, small.edst, valid, k, D, F
+        )
+        return merged._replace(
+            overflow=merged.overflow | small.overflow,
+            deg_overflow=merged.deg_overflow + small.deg_overflow,
+        )
+
+    return SummaryAggregation(
+        init=init,
+        fold=fold,
+        combine=combine,
+        transform=None,
+        name=f"sparse-spanner-k{k}",
+    )
+
+
 def spanner(vertex_capacity: int, k: int,
-            max_edges: int | None = None) -> SummaryAggregation:
+            max_edges: int | None = None,
+            max_degree: int | None = None) -> SummaryAggregation:
     """Build the k-spanner aggregation (Spanner.java ctor takes
     (mergeWindowTime, k); the merge cadence is the runner's merge_every /
-    window_ms here)."""
+    window_ms here). ``max_degree`` switches to the capped-degree sparse
+    summary (the N >= 1M path)."""
+    if max_degree is not None:
+        return sparse_spanner(vertex_capacity, k, max_degree, max_edges)
     n = vertex_capacity
     e_cap = max_edges if max_edges is not None else 4 * n
 

@@ -1,0 +1,40 @@
+"""Capped-degree neighbor-row tables — the sparse adjacency primitive.
+
+The reference's per-vertex ``TreeSet``/``HashSet`` adjacencies
+(``M/summaries/AdjacencyListGraph.java:31``, ``BuildNeighborhoods``,
+``M/SimpleEdgeStream.java:540-560``) become a fixed-shape ``i32[N, D]``
+table: row ``v`` holds up to ``D`` neighbor slots (-1 empty) with a dense
+``deg[N]`` fill counter. O(N*D) memory is the N >= 1M path everywhere a
+dense ``bool[N, N]`` would blow up (sparse exact triangles, sparse
+spanner, sparse buildNeighborhood).
+
+Inserts past the cap are *counted* by the caller-supplied overflow
+accumulator — consumers decide whether that is an error (neighborhood,
+triangles: raise) or a safe degradation (spanner: reachability
+under-report only ever accepts extra edges).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def row_insert(nbr, deg, over, a, b, ok, max_degree: int,
+               dedupe: bool = True):
+    """Append neighbor ``b`` to row ``a`` (scalars, inside a scan step).
+
+    ``dedupe=True`` gives set semantics (duplicates are no-ops — TreeSet
+    parity); overflow increments ``over`` instead of clobbering. Returns
+    the updated ``(nbr, deg, over)``.
+    """
+    if dedupe:
+        present = jnp.any(nbr[a] == b, axis=0)
+        fresh = ok & ~present
+    else:
+        fresh = ok
+    fits = fresh & (deg[a] < max_degree)
+    slot = jnp.minimum(deg[a], max_degree - 1)
+    nbr = nbr.at[a, slot].set(jnp.where(fits, b, nbr[a, slot]))
+    deg = deg.at[a].add(fits.astype(jnp.int32))
+    over = over + (fresh & ~fits).astype(jnp.int32)
+    return nbr, deg, over

@@ -164,3 +164,39 @@ def test_fold_neighbors_tuple_accumulator(reference_edges):
             got[k] = (int(vid), int(total))
     slot_of = {int(r): i for i, r in enumerate(s.ctx.table._rev.tolist())}
     assert got == {k: (slot_of[k], v) for k, v in EXPECTED["out"].items()}
+
+
+def test_sparse_neighborhood_matches_dense():
+    from gelly_tpu.core.neighborhood import NeighborhoodStream
+
+    rng = np.random.default_rng(6)
+    edges = list(zip(rng.integers(0, 32, 150).tolist(),
+                     rng.integers(0, 32, 150).tolist()))
+
+    def stream():
+        return edge_stream_from_edges(edges, vertex_capacity=32, chunk_size=16)
+
+    dense = NeighborhoodStream(stream())
+    sparse = NeighborhoodStream(stream(), max_degree=32)
+    for v in {a for a, _ in edges} | {b for _, b in edges}:
+        assert dense.neighbors_of(v) == sparse.neighbors_of(v), v
+
+
+def test_sparse_neighborhood_million_vertices_and_overflow():
+    import pytest
+
+    from gelly_tpu.core.neighborhood import NeighborhoodStream
+
+    n_v = 1 << 20
+    rng = np.random.default_rng(7)
+    ids = rng.choice(n_v, 40, replace=False).astype(np.int64)
+    edges = [(int(ids[i]), int(ids[i + 1])) for i in range(39)]
+    s = edge_stream_from_edges(edges, vertex_capacity=n_v, chunk_size=16)
+    ns = NeighborhoodStream(s, max_degree=4)
+    assert ns.neighbors_of(int(ids[1])) == sorted({int(ids[0]), int(ids[2])})
+
+    # Hot vertex past the cap raises (no silently truncated neighborhoods).
+    star = [(0, i) for i in range(1, 20)]
+    s2 = edge_stream_from_edges(star, vertex_capacity=64, chunk_size=8)
+    with pytest.raises(ValueError, match="max_degree"):
+        NeighborhoodStream(s2, max_degree=4).final_adjacency()

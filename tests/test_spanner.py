@@ -89,3 +89,71 @@ def test_spanner_overflow_flag():
     summary = s.aggregate(spanner(16, 2, max_edges=4), merge_every=1).result()
     with pytest.raises(RuntimeError, match="overflow"):
         spanner_edges(summary, s.ctx)
+
+
+def test_sparse_spanner_matches_dense_when_unconstrained():
+    # With generous degree/frontier caps the sparse gate sees the same
+    # reachability as the dense one => identical accepted edge lists.
+    from gelly_tpu.library.spanner import spanner, spanner_edges
+
+    rng = np.random.default_rng(4)
+    n_v = 64
+    edges = list(zip(rng.integers(0, n_v, 200).tolist(),
+                     rng.integers(0, n_v, 200).tolist()))
+
+    def run(**kw):
+        s = edge_stream_from_edges(edges, vertex_capacity=n_v, chunk_size=64)
+        summ = s.aggregate(spanner(n_v, 3, **kw), merge_every=8).result()
+        return spanner_edges(summ, s.ctx)
+
+    assert run(max_degree=n_v, max_edges=256) == run(max_edges=256)
+
+
+def test_sparse_spanner_million_vertex_stretch_property():
+    # O(N*D) memory at N = 1M; caps degrade conservatively, so the
+    # k-stretch property must hold for every input edge regardless.
+    from gelly_tpu.library.spanner import spanner, spanner_edges
+
+    n_v = 1 << 20
+    k = 3
+    rng = np.random.default_rng(5)
+    ids = rng.choice(n_v, 60, replace=False).astype(np.int64)
+    edges = []
+    for i in range(0, 60, 6):  # small cliques spread over the id space
+        group = ids[i:i + 6]
+        edges += [(int(a), int(b)) for a in group for b in group if a < b]
+    rng.shuffle(edges)
+
+    s = edge_stream_from_edges(edges, vertex_capacity=n_v, chunk_size=32)
+    summ = s.aggregate(
+        spanner(n_v, k, max_edges=256, max_degree=8), merge_every=8
+    ).result()
+    accepted = set(map(tuple, spanner_edges(summ, s.ctx)))
+    assert 0 < len(accepted) < len(set(
+        (min(a, b), max(a, b)) for a, b in edges
+    ))
+
+    # Host BFS stretch check over the spanner for every input edge.
+    # Across partition/window merges the gate re-applies to partial
+    # spanners (CombineSpanners semantics, Spanner.java:91-116), so the
+    # end-to-end guarantee is k per merge level — assert the k^2 bound
+    # that one level of merging provides (the reference degrades the same
+    # way; its own tests only assert scenario behavior).
+    adj: dict[int, set] = {}
+    for a, b in accepted:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+
+    def within(u, v, hops):
+        frontier = {u}
+        seen = {u}
+        for _ in range(hops):
+            if v in frontier:
+                return True
+            frontier = {w for x in frontier for w in adj.get(x, ())} - seen
+            seen |= frontier
+        return v in frontier
+
+    for a, b in edges:
+        if a != b:
+            assert within(a, b, k * k), (a, b)
