@@ -38,14 +38,14 @@ def synth_edges(num_edges: int, num_vertices: int, seed: int = 7):
 
 
 def baseline_cc(src: np.ndarray, dst: np.ndarray,
-                cap_edges: int = 4_000_000) -> tuple[dict, float, int]:
+                cap_edges: int = 4_000_000) -> tuple[float, int]:
     """Reference-semantics per-edge union-find fold on host CPU.
 
     Folds every edge through ``DisjointSet.union`` semantics one at a time
     (the reference's actual execution shape). Timed on a prefix of up to
     ``cap_edges`` (per-edge cost is flat, so the rate extrapolates); the
-    *full* stream is then folded untimed so the parity oracle compares
-    complete label sets.
+    full-stream parity oracle lives in :func:`baseline_cc_numpy` (same
+    components, ~6x faster to compute).
     """
     parent: dict[int, int] = {}
 
@@ -72,41 +72,44 @@ def baseline_cc(src: np.ndarray, dst: np.ndarray,
 
     n_timed = min(cap_edges, src.shape[0])
     # Best of 2, symmetric with the accelerator side's repeat policy.
+    # Timing only — the full-stream parity oracle comes from the (much
+    # faster) vectorized numpy baseline.
     dt = float("inf")
     for _ in range(2):
         parent.clear()
         t0 = time.perf_counter()
         fold(src[:n_timed], dst[:n_timed])
         dt = min(dt, time.perf_counter() - t0)
-    fold(src[n_timed:], dst[n_timed:])  # untimed remainder for the oracle
-    labels = {x: find(x) for x in parent}
-    return labels, dt, n_timed
+    return dt, n_timed
 
 
 def baseline_cc_numpy(src: np.ndarray, dst: np.ndarray, num_vertices: int,
-                      chunk_size: int, cap_edges: int = 8_000_000) -> float:
+                      chunk_size: int, cap_edges: int = 8_000_000):
     """Vectorized host baseline with the same streaming semantics.
 
     The strongest honest CPU comparison: per-chunk spanning-forest reduction
     (vectorized numpy min-label propagation) folded into a global forest —
     i.e. the same chunked pipeline as the TPU path, minus the device.
-    Returns measured edges/sec (timed on a prefix of up to ``cap_edges``).
+    Returns ``(edges/sec timed on a prefix of cap_edges, full-stream global
+    labels)`` — the labels double as the parity oracle (identical
+    components to the per-edge fold; union is order-free).
     """
     from gelly_tpu.library.connected_components import cc_labels_numpy
 
+    s32 = src.astype(np.int32)
+    d32 = dst.astype(np.int32)
     n = min(cap_edges, src.shape[0])
-    s32 = src[:n].astype(np.int32)
-    d32 = dst[:n].astype(np.int32)
-    dt = float("inf")
-    for _ in range(2):
-        t0 = time.perf_counter()
+
+    def run(n_run):
         glob = np.arange(num_vertices, dtype=np.int32)
-        for lo in range(0, n, chunk_size):
+        seen = np.zeros(num_vertices, bool)
+        for lo in range(0, n_run, chunk_size):
             lab = cc_labels_numpy(
                 s32[lo:lo + chunk_size], d32[lo:lo + chunk_size],
                 None, num_vertices,
             )
             ok = lab >= 0
+            seen |= ok
             # merge chunk forest into the global forest (label propagation)
             v = np.nonzero(ok)[0].astype(np.int32)
             r = lab[v]
@@ -119,8 +122,15 @@ def baseline_cc_numpy(src: np.ndarray, dst: np.ndarray, num_vertices: int,
                 glob = np.minimum(glob, glob[glob])
                 if np.array_equal(glob, prev):
                     break
+        return glob, seen
+
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run(n)
         dt = min(dt, time.perf_counter() - t0)
-    return n / dt
+    glob, seen = run(src.shape[0])  # untimed full stream: the oracle
+    return n / dt, np.where(seen, glob, -1)
 
 
 def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int,
@@ -263,10 +273,11 @@ def bench_triangles(args):
     from gelly_tpu.core.vertices import IdentityVertexTable
     from gelly_tpu.library.triangles import window_triangles
 
+    n_e = min(args.edges, 1_000_000)  # windowed wedge matching: bounded size
     n_v = min(args.vertices, 1 << 12)
-    src, dst = synth_edges(args.edges, n_v)
-    ts = np.arange(args.edges, dtype=np.int64)  # 10 windows
-    window_ms = args.edges // 10
+    src, dst = synth_edges(n_e, n_v)
+    ts = np.arange(n_e, dtype=np.int64)  # 10 windows
+    window_ms = n_e // 10
 
     def stream():
         return edge_stream_from_source(
@@ -283,22 +294,24 @@ def bench_triangles(args):
                           window_capacity=2 * args.chunk_size))  # warmup
     import jax.numpy as jnp
 
-    t0 = time.perf_counter()
-    # Keep per-window counts on device; one batched pull at the end (each
-    # host sync costs ~100ms fixed latency on a tunneled TPU).
-    wins, counts = zip(*window_triangle_counts_device(
-        stream(), window_ms, window_capacity=2 * args.chunk_size))
-    counts = np.asarray(jnp.stack(counts))
-    dt = time.perf_counter() - t0
+    dt = float("inf")
+    for _ in range(2):  # best-of-2: damp shared-device variance
+        t0 = time.perf_counter()
+        # Keep per-window counts on device; one batched pull at the end
+        # (each host sync costs ~100ms fixed latency on a tunneled TPU).
+        wins, counts = zip(*window_triangle_counts_device(
+            stream(), window_ms, window_capacity=2 * args.chunk_size))
+        counts = np.asarray(jnp.stack(counts))
+        dt = min(dt, time.perf_counter() - t0)
     ours = dict(zip(wins, counts.tolist()))
 
     t0 = time.perf_counter()
     base: dict[int, int] = {}
-    for w in range(0, args.edges, window_ms):
+    for w in range(0, n_e, window_ms):
         adj: dict[int, set] = {}
         cnt = 0
         seen = set()
-        for i in range(w, min(w + window_ms, args.edges)):
+        for i in range(w, min(w + window_ms, n_e)):
             a, b = int(src[i]), int(dst[i])
             if a == b or (a, b) in seen or (b, a) in seen:
                 continue
@@ -312,7 +325,7 @@ def bench_triangles(args):
     dt_base = time.perf_counter() - t0
     if ours != base:
         raise SystemExit(f"triangle parity FAILED: {ours} vs {base}")
-    return "window_triangles_throughput", args.edges / dt, args.edges / dt_base
+    return "window_triangles_throughput", n_e / dt, n_e / dt_base
 
 
 def bench_bipartiteness(args):
@@ -469,9 +482,11 @@ def bench_cc(args) -> dict:
     )
     eps = args.edges / dt_tpu
 
-    base_labels, dt_base, n_base = baseline_cc(src, dst)
+    dt_base, n_base = baseline_cc(src, dst)
     base_eps = n_base / dt_base
-    numpy_eps = baseline_cc_numpy(src, dst, args.vertices, args.chunk_size)
+    numpy_eps, oracle_labels = baseline_cc_numpy(
+        src, dst, args.vertices, args.chunk_size
+    )
 
     if not args.skip_parity:
         lab = np.asarray(labels)
@@ -480,7 +495,10 @@ def bench_cc(args) -> dict:
         ours = components_of(
             {int(r): int(lab[s]) for s, r in zip(slots, raw)}
         )
-        theirs = components_of(base_labels)
+        o_slots = np.nonzero(oracle_labels >= 0)[0]
+        theirs = components_of(
+            {int(s): int(oracle_labels[s]) for s in o_slots}
+        )
         if ours != theirs:
             raise SystemExit(json.dumps({
                 "error": "label parity FAILED",

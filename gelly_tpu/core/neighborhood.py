@@ -99,22 +99,18 @@ class NeighborhoodStream:
         nbr = jnp.full((n, self.max_degree), -1, jnp.int32)
         deg = jnp.zeros((n,), jnp.int32)
         over = jnp.zeros((), jnp.int32)
-        prev_over = None
         for c in self.stream:
             self._check_range(c)
             nbr, deg, over = _row_step(
                 nbr, deg, over, c, self.directed, self.max_degree
             )
-            # Check the PREVIOUS chunk's overflow after dispatching this
-            # one: the host sync lands on finished work, keeping async
-            # dispatch pipelined (same pattern as the sparse triangle
-            # stream).
-            if prev_over is not None and int(prev_over):
-                raise self._overflow_error(int(prev_over))
-            prev_over = over
+            # Synchronous overflow check: consumers act on every yielded
+            # snapshot, so a truncated row must never be observable (the
+            # one-chunk-deferred pattern used by the sparse triangle stream
+            # would leak one). Costs one host sync per chunk.
+            if int(over):
+                raise self._overflow_error(int(over))
             yield nbr, deg
-        if prev_over is not None and int(prev_over):
-            raise self._overflow_error(int(prev_over))
 
     def final_adjacency(self):
         """Drained adjacency; cached so repeated queries (neighbors_of) don't

@@ -162,7 +162,12 @@ def sparse_spanner(vertex_capacity: int, k: int, max_degree: int,
     bound); ``deg_overflow`` counts how often that happened."""
     n = vertex_capacity
     D = max_degree
-    e_cap = max_edges if max_edges is not None else 64 * 1024
+    # A spanner of a connected graph needs up to ~k-spanner-size edges;
+    # default to the dense path's 4*n so the sparse scale target (N >= 1M)
+    # works out of the box. NOTE: the combine re-gates the smaller list
+    # edge-by-edge (CombineSpanners semantics), so its cost scales with
+    # max_edges — tighten it when the expected spanner is small.
+    e_cap = max_edges if max_edges is not None else 4 * n
     F = frontier_cap if frontier_cap is not None else max(32, 4 * D)
 
     def init() -> SparseSpannerSummary:
@@ -246,12 +251,25 @@ def spanner(vertex_capacity: int, k: int,
     )
 
 
-def spanner_edges(summary: SpannerSummary, ctx) -> list[tuple[int, int]]:
+def spanner_edges(summary, ctx) -> list[tuple[int, int]]:
     """Decode the accepted edge list to raw-id pairs (the reference's
-    flattened adjacency printout, SpannerExample.java:139-153)."""
+    flattened adjacency printout, SpannerExample.java:139-153).
+
+    Pairs are set-deduped: the sparse path can re-take an edge whose row
+    inserts were dropped by the degree cap (the adjacency then under-
+    reports reachability — conservative), so the list may hold repeats of
+    the same undirected pair; the spanner is its edge *set*.
+    """
     if bool(summary.overflow):
         raise RuntimeError("spanner edge list overflowed; raise max_edges")
     m = int(summary.n)
-    src = ctx.decode(np.asarray(summary.esrc[:m]))
-    dst = ctx.decode(np.asarray(summary.edst[:m]))
+    src = np.asarray(summary.esrc[:m])
+    dst = np.asarray(summary.edst[:m])
+    lo = np.minimum(src, dst)
+    hi = np.maximum(src, dst)
+    _, first = np.unique(lo.astype(np.int64) * (1 << 32) + hi,
+                         return_index=True)
+    keep = np.sort(first)  # preserve insertion order
+    src = ctx.decode(src[keep])
+    dst = ctx.decode(dst[keep])
     return list(zip(src.tolist(), dst.tolist()))
