@@ -94,7 +94,10 @@ def baseline_cc_numpy(src: np.ndarray, dst: np.ndarray, num_vertices: int,
     labels)`` — the labels double as the parity oracle (identical
     components to the per-edge fold; union is order-free).
     """
-    from gelly_tpu.library.connected_components import cc_labels_numpy
+    from gelly_tpu.library.connected_components import (
+        cc_labels_numpy,
+        merge_chunk_forest,
+    )
 
     s32 = src.astype(np.int32)
     d32 = dst.astype(np.int32)
@@ -108,20 +111,8 @@ def baseline_cc_numpy(src: np.ndarray, dst: np.ndarray, num_vertices: int,
                 s32[lo:lo + chunk_size], d32[lo:lo + chunk_size],
                 None, num_vertices,
             )
-            ok = lab >= 0
-            seen |= ok
-            # merge chunk forest into the global forest (label propagation)
-            v = np.nonzero(ok)[0].astype(np.int32)
-            r = lab[v]
-            while True:
-                prev = glob
-                mn = np.minimum(glob[v], glob[r])
-                glob = glob.copy()
-                np.minimum.at(glob, v, mn)
-                np.minimum.at(glob, r, mn)
-                glob = np.minimum(glob, glob[glob])
-                if np.array_equal(glob, prev):
-                    break
+            seen |= lab >= 0
+            glob = merge_chunk_forest(glob, lab)
         return glob, seen
 
     dt = float("inf")

@@ -184,9 +184,11 @@ class SnapshotStream:
             else:
                 yield c
 
-    def _windows(self) -> Iterator[tuple[int, NeighborhoodView]]:
-        """Assemble per-window sorted views (tumbling, ascending-ts).
-        ``stats`` reflects the most recent drain (reset per run)."""
+    def host_buffers(self) -> Iterator[tuple[int, tuple]]:
+        """(window, (key, nbr, val, valid)) per closed window with HOST
+        numpy arrays — sorted by key, padding keys = INT_MAX. The escape
+        hatch for consumers bringing their own wire codec (e.g. the
+        packed window-triangle path): nothing is uploaded here."""
         from .windows import tumbling_window_events
 
         self.stats["late_edges"] = 0
@@ -199,9 +201,9 @@ class SnapshotStream:
         ):
             if kind == "close":
                 c0 = parts[0]
-                yield w, _sorted_view(_assemble_buffer(
+                yield w, _assemble_buffer(
                     parts, cap, c0.val.dtype, c0.val.shape[1:]
-                ))
+                )
                 self.stats["windows_closed"] += 1
                 parts = []
                 fill_host = 0
@@ -215,6 +217,12 @@ class SnapshotStream:
                 )
             parts.append(chunk)
             fill_host += n_valid
+
+    def _windows(self) -> Iterator[tuple[int, NeighborhoodView]]:
+        """Assemble per-window sorted views (tumbling, ascending-ts).
+        ``stats`` reflects the most recent drain (reset per run)."""
+        for w, buf in self.host_buffers():
+            yield w, _sorted_view(buf)
 
     # -------------------------------------------------------------- #
     # aggregations

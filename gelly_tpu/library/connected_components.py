@@ -80,6 +80,32 @@ def cc_labels_numpy(src: np.ndarray, dst: np.ndarray,
     return lab
 
 
+def merge_chunk_forest(glob: np.ndarray, lab: np.ndarray) -> np.ndarray:
+    """Hook a chunk's spanning-forest labels into a global dense forest
+    (host numpy — the vectorized CPU analog of the device union).
+
+    Shiloach-Vishkin shape: hook at LABEL (root) indices — writing at the
+    vertex indices would lose transitivity when a later chunk lowers part
+    of an old component (the old root never learns) — plus one doubling
+    step per round until fixpoint. Returns the updated ``glob``.
+    """
+    ok = lab >= 0
+    v = np.nonzero(ok)[0].astype(np.int32)
+    r = lab[v]
+    while True:
+        prev = glob
+        lab_u = glob[v]
+        lab_v = glob[r]
+        lab_lo = np.minimum(lab_u, lab_v)
+        lab_hi = np.maximum(lab_u, lab_v)
+        glob = glob.copy()
+        np.minimum.at(glob, lab_hi, lab_lo)
+        glob = np.minimum(glob, glob[glob])
+        if np.array_equal(glob, prev):
+            break
+    return glob
+
+
 def connected_components(
     vertex_capacity: int, merge: str = "tree", ingest_combine: bool = True
 ) -> SummaryAggregation:

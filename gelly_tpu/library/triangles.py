@@ -109,6 +109,29 @@ def _check_slot_range(capacity: int, full_capacity: int, *arrays_with_mask):
             )
 
 
+@partial(jax.jit, static_argnames=("n", "capacity", "method"))
+def _window_triangle_count_packed(packed: jax.Array, n: int, capacity: int,
+                                  method: str) -> jax.Array:
+    """Packed-wire variant: ``packed[i] = key*n + nbr`` (INT_MAX padding).
+
+    The window view's key/nbr/valid columns compress into one i32 on the
+    host — the H2D transfer is the dominant window cost on a
+    bandwidth-limited link, and the triangle count never reads ``val``."""
+    valid = packed != segments.INT_MAX
+    safe = jnp.where(valid, packed, 0)
+    key = (safe // n).astype(jnp.int32)
+    nbr = (safe % n).astype(jnp.int32)
+    view = NeighborhoodView(
+        key=jnp.where(valid, key, segments.INT_MAX),
+        nbr=nbr,
+        val=jnp.zeros((), jnp.float32),  # unused by the count
+        valid=valid,
+        starts=jnp.zeros_like(valid),  # unused by the count
+        seg_id=jnp.zeros_like(key),  # unused by the count
+    )
+    return _window_triangle_count(view, capacity, method)
+
+
 def window_triangle_counts_device(stream, window_ms: int,
                                   capacity: int | None = None,
                                   window_capacity: int | None = None,
@@ -116,21 +139,41 @@ def window_triangle_counts_device(stream, window_ms: int,
     """Like :func:`window_triangles` but yields (window, device_scalar)
     WITHOUT host synchronization — counts stay on device so windows
     pipeline. Batch-pull at the end (one D2H round-trip instead of one per
-    window; on a tunneled TPU a sync costs ~100ms of fixed latency)."""
+    window; on a tunneled TPU a sync costs ~100ms of fixed latency).
+
+    When the slot space fits (capacity^2 < 2^31) the window view ships as
+    ONE packed i32 column instead of key/nbr/val/valid — ~3x fewer wire
+    bytes for the dominant per-window transfer.
+    """
     n = capacity if capacity is not None else stream.ctx.vertex_capacity
     snap = stream.slice(window_ms, "all", window_capacity=window_capacity)
+    pack = n * n < (1 << 31)
+
+    def pick(view_len):
+        if method != "auto":
+            return method
+        from ..ops.pallas_kernels import on_tpu
+
+        dense = view_len >= n and n % 128 == 0
+        return "mxu" if (dense and on_tpu()) else "gather"
+
+    if pack:
+        for w, (bk, bn, _bv, bo) in snap.host_buffers():
+            _check_slot_range(n, stream.ctx.vertex_capacity,
+                              (bk, bo), (bn, bo))
+            packed = np.where(
+                bo, bk.astype(np.int64) * n + bn, segments.INT_MAX
+            ).astype(np.int32)
+            yield w, _window_triangle_count_packed(
+                packed, n, n, pick(packed.shape[0])
+            )
+        return
     for w, view in snap.views():
         _check_slot_range(
             n, stream.ctx.vertex_capacity,
             (view.key, view.valid), (view.nbr, view.valid),
         )
-        m = method
-        if m == "auto":
-            from ..ops.pallas_kernels import on_tpu
-
-            dense = view.key.shape[0] >= n and n % 128 == 0
-            m = "mxu" if (dense and on_tpu()) else "gather"
-        yield w, _window_triangle_count(view, n, m)
+        yield w, _window_triangle_count(view, n, pick(view.key.shape[0]))
 
 
 def window_triangles(stream, window_ms: int, capacity: int | None = None,
