@@ -147,10 +147,21 @@ class EdgeChunkSource:
     def __iter__(self) -> Iterator[EdgeChunk]:
         n = self.num_edges
         cs = self.chunk_size
+        src_all = dst_all = None
+        if isinstance(self.table, IdentityVertexTable):
+            # Identity densification is stateless: encode the whole stream
+            # once so per-chunk src/dst are zero-copy views (the per-chunk
+            # astype was a serial ~ms/chunk cost on the ingest thread).
+            src_all = self.table.encode(self.src_raw)
+            dst_all = self.table.encode(self.dst_raw)
         for lo in range(0, n, cs):
             hi = min(lo + cs, n)
-            src = self.table.encode(self.src_raw[lo:hi])
-            dst = self.table.encode(self.dst_raw[lo:hi])
+            if src_all is not None:
+                src = src_all[lo:hi]
+                dst = dst_all[lo:hi]
+            else:
+                src = self.table.encode(self.src_raw[lo:hi])
+                dst = self.table.encode(self.dst_raw[lo:hi])
             yield make_chunk(
                 src,
                 dst,
