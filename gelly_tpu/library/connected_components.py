@@ -20,7 +20,6 @@ final parity oracle (component-set equality, as the reference's test asserts,
 
 from __future__ import annotations
 
-import subprocess
 from typing import NamedTuple
 
 import jax
@@ -37,21 +36,12 @@ class CCSummary(NamedTuple):
     seen: jax.Array  # bool[N] vertices observed in the stream
 
 
-_NATIVE_STATE = {"ok": None}
-
-
 def _native_ok() -> bool:
-    """Probe the native combiner once; negative-cache failures so a missing
-    toolchain doesn't re-run g++ per chunk on the ingest hot path."""
-    if _NATIVE_STATE["ok"] is None:
-        try:
-            from ..utils import native
+    """Is the native chunk combiner available? (Probed once, negative-cached
+    in utils.native so a missing toolchain doesn't re-run g++ per chunk.)"""
+    from ..utils import native
 
-            native._load_combiner()
-            _NATIVE_STATE["ok"] = True
-        except (OSError, subprocess.SubprocessError, AttributeError):
-            _NATIVE_STATE["ok"] = False
-    return _NATIVE_STATE["ok"]
+    return native.available("chunk_combiner")
 
 
 def cc_labels_numpy(src: np.ndarray, dst: np.ndarray,

@@ -3,11 +3,13 @@
 TPU-native re-design of ``M/example/CentralizedWeightedMatching.java:36-113``:
 the reference is a parallelism-1 stateful flatMap holding a ``Set<Edge>``; a
 new edge evicts its colliding matched edges iff its weight exceeds twice
-their combined weight. Here the matching lives in two dense device arrays —
-``partner[i32 N]`` (-1 = unmatched) and ``weight[f32 N]`` (stored at both
-endpoints) — and the inherently sequential per-edge decision runs as a
-``lax.scan`` per chunk on a single device (the stage is centralized in the
-reference too, ``:59-60``).
+their combined weight. Here the matching lives in two dense arrays —
+``partner[i32 N]`` (-1 = unmatched) and ``weight[N]`` (stored at both
+endpoints; f64 on the host paths like the reference's Java doubles, f32 on
+the device path) — and the inherently sequential per-edge decision folds
+chunk by chunk on the host via a native C++ stage (``native/matching.cc``)
+or, for pipelines that must stay resident, as a ``lax.scan`` on a single
+device (the stage is centralized in the reference too, ``:59-60``).
 """
 
 from __future__ import annotations
@@ -73,18 +75,49 @@ def _matching_step(state: MatchingState, chunk) -> MatchingState:
     return out
 
 
+_NATIVE = None  # test hook: False forces the Python fallback
+
+
+def _native_ok() -> bool:
+    if _NATIVE is not None:
+        return _NATIVE
+    from ..utils import native
+
+    return native.available("matching")
+
+
 def _matching_step_host(state: MatchingState, chunk,
                         events: list | None = None) -> MatchingState:
-    """Host per-edge loop over the chunk's valid edges — the default path.
+    """Host per-edge fold over the chunk's valid edges — the default path.
 
     The stage is a strictly-sequential scalar state machine (the reference
     runs it as one parallelism-1 operator, CentralizedWeightedMatching.java
     :59-60); a device lax.scan pays per-step scatter latency for ~10 scalar
-    ops of real work, so the host loop is ~100x faster. The device variant
-    remains available (device=True) for pipelines that must stay resident.
+    ops of real work, so the host path is ~100x faster. It runs as a native
+    C++ fold (``native/matching.cc``) when the toolchain is available, with
+    this Python loop as the fallback. The device variant remains available
+    (device=True) for pipelines that must stay resident.
     """
     partner = np.asarray(state.partner).copy()
     weight = np.asarray(state.weight).copy()
+    if _native_ok():
+        from ..utils.native import matching_chunk_fold
+
+        out = matching_chunk_fold(
+            np.asarray(chunk.src), np.asarray(chunk.dst),
+            np.asarray(chunk.val), np.asarray(chunk.valid),
+            partner.shape[0], partner, weight,
+            want_events=events is not None,
+        )
+        if events is not None:
+            types, a, b, w = out
+            for t, x, y, wt in zip(
+                types.tolist(), a.tolist(), b.tolist(), w.tolist()
+            ):
+                events.append(MatchingEvent(
+                    "ADD" if t == 0 else "REMOVE", x, y, wt
+                ))
+        return MatchingState(partner, weight)
     m = np.asarray(chunk.valid)
     for u, v, w in zip(
         np.asarray(chunk.src)[m].tolist(),
@@ -141,7 +174,7 @@ class WeightedMatchingStream:
             return
         state = MatchingState(
             partner=np.full((n,), -1, np.int32),
-            weight=np.zeros((n,), np.float32),
+            weight=np.zeros((n,), np.float64),
         )
         for c in self.stream:
             state = _matching_step_host(state, c)
@@ -161,7 +194,7 @@ class WeightedMatchingStream:
         n = ctx.vertex_capacity
         state = MatchingState(
             partner=np.full((n,), -1, np.int32),
-            weight=np.zeros((n,), np.float32),
+            weight=np.zeros((n,), np.float64),
         )
         for c in self.stream:
             evs: list = []
@@ -189,7 +222,7 @@ class WeightedMatchingStream:
                 n = self.stream.ctx.vertex_capacity
                 state = MatchingState(
                     partner=np.full((n,), -1, np.int32),
-                    weight=np.zeros((n,), np.float32),
+                    weight=np.zeros((n,), np.float64),
                 )
             self._final = state
             self._drained = True

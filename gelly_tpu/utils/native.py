@@ -9,7 +9,9 @@ shared objects next to the source; no pip/pybind dependency) and exposes
 - :func:`cc_chunk_combine` / :func:`parity_chunk_combine` — ingest-side
   chunk pre-aggregation: union-find (plain / parity) over one chunk,
   emitting a dense spanning-forest label array for compressed H2D transfer
-  (``native/chunk_combiner.cc``).
+  (``native/chunk_combiner.cc``);
+- :func:`matching_chunk_fold` — the centralized greedy weighted-matching
+  stage folded natively over one chunk (``native/matching.cc``).
 
 Import failures (no compiler, read-only tree) degrade gracefully: callers
 fall back to pure-numpy implementations.
@@ -95,6 +97,96 @@ def _load_combiner() -> ctypes.CDLL:
 
 def _as_i32p(a: np.ndarray):
     return a.ctypes.data_as(_i32p)
+
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_f64p = ctypes.POINTER(ctypes.c_double)
+
+_AVAILABLE: dict[str, bool] = {}
+
+
+def available(stem: str) -> bool:
+    """Probe (compile + dlopen + bind) one native component, by source stem;
+    negative-cache failures so a missing toolchain doesn't re-run g++ per
+    chunk on ingest hot paths."""
+    if stem not in _AVAILABLE:
+        loader = {
+            "edgelist_parser": _load,
+            "chunk_combiner": _load_combiner,
+            "matching": _load_matching,
+        }[stem]
+        try:
+            loader()
+            _AVAILABLE[stem] = True
+        except (OSError, subprocess.SubprocessError, AttributeError):
+            _AVAILABLE[stem] = False
+    return _AVAILABLE[stem]
+
+
+def _load_matching() -> ctypes.CDLL:
+    lib = _load_lib("matching")
+    if not getattr(lib, "_sigs_set", False):
+        lib.matching_chunk_fold.restype = ctypes.c_int
+        lib.matching_chunk_fold.argtypes = [
+            _i32p, _i32p, _f64p, _u8p, ctypes.c_int64, ctypes.c_int32,
+            _i32p, _f64p,
+            _u8p, _i32p, _i32p, _f64p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib._sigs_set = True
+    return lib
+
+
+def matching_chunk_fold(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+                        valid: np.ndarray | None, n_v: int,
+                        partner: np.ndarray, weight: np.ndarray,
+                        want_events: bool = False):
+    """Fold one chunk into the greedy-matching state, in stream order.
+
+    ``partner`` (i32[n_v], C-contiguous) and ``weight`` (f64[n_v]) are
+    mutated in place. With ``want_events`` returns the chunk's ordered
+    event records ``(types u8[k], a i32[k], b i32[k], w f64[k])`` where
+    type 0 = ADD, 1 = REMOVE; otherwise returns None. ctypes releases the
+    GIL during the call.
+    """
+    lib = _load_matching()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    w = np.ascontiguousarray(w, np.float64)
+    assert partner.dtype == np.int32 and partner.flags.c_contiguous
+    assert weight.dtype == np.float64 and weight.flags.c_contiguous
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    n = src.shape[0]
+    if want_events:
+        cap = 3 * n
+        ev_type = np.empty((cap,), np.uint8)
+        ev_a = np.empty((cap,), np.int32)
+        ev_b = np.empty((cap,), np.int32)
+        ev_w = np.empty((cap,), np.float64)
+        ev_args = (
+            ev_type.ctypes.data_as(_u8p), _as_i32p(ev_a), _as_i32p(ev_b),
+            ev_w.ctypes.data_as(_f64p),
+        )
+    else:
+        ev_args = (None, None, None, None)
+        cap = 0
+    count = ctypes.c_int64(0)
+    rc = lib.matching_chunk_fold(
+        _as_i32p(src), _as_i32p(dst), w.ctypes.data_as(_f64p), vp, n,
+        n_v, _as_i32p(partner), weight.ctypes.data_as(_f64p),
+        *ev_args, cap, ctypes.byref(count),
+    )
+    if rc == 3:
+        raise ValueError("matching_chunk_fold: event buffer overflow")
+    if rc != 0:
+        raise ValueError(f"matching_chunk_fold: bad vertex slot (rc={rc})")
+    if want_events:
+        k = count.value
+        return ev_type[:k], ev_a[:k], ev_b[:k], ev_w[:k]
+    return None
 
 
 def cc_chunk_combine(src: np.ndarray, dst: np.ndarray,

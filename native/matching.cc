@@ -1,0 +1,100 @@
+// Native centralized greedy weighted-matching stage.
+//
+// The reference runs greedy ½-approximate weighted matching as one
+// parallelism-1 stateful operator: a new edge evicts its colliding matched
+// edges iff its weight exceeds twice their combined weight
+// (/root/reference/src/main/java/org/apache/flink/graph/streaming/example/
+// CentralizedWeightedMatching.java:76-107). The decision chain is strictly
+// sequential per edge, so it belongs on the host — this kernel is the native
+// runtime stage behind gelly_tpu/library/matching.py's host path (the
+// per-edge Python loop remains as the fallback).
+//
+// State layout mirrors the Python host path exactly: partner[i32 n_v]
+// (-1 = unmatched) and weight[f64 n_v] (the matched edge's weight stored at
+// both endpoints). All weight arithmetic is double, like the reference's
+// Java doubles — the Python fallback keeps its state in float64 for the
+// same reason.
+//
+// Exposed via ctypes (gelly_tpu/utils/native.py); no pybind dependency.
+
+#include <cstdint>
+
+extern "C" {
+
+// Fold one chunk of edges into the matching state, in stream order.
+//
+//   src/dst : dense vertex slots, i32[n]
+//   w       : edge weights, f64[n] (chunk values promoted by the caller)
+//   valid   : optional u8 mask (null = all valid)
+//   partner : i32[n_v] in/out, -1 = unmatched
+//   weight  : f64[n_v] in/out
+//
+// Event emission (optional, all-or-nothing): when ev_type != null, every
+// accepted edge appends up to two REMOVE records (type 1, pair (x, partner
+// of x), weight of the evicted edge) followed by one ADD record (type 0,
+// (u, v), w) — the reference's MatchingEvent collector output
+// (CentralizedWeightedMatching.java:99-104). Buffers must hold ev_cap
+// records; *ev_count receives the number written.
+//
+// Returns 0 on success, 2 on a slot outside [0, n_v), 3 on event overflow
+// (cannot happen with ev_cap >= 3n).
+int matching_chunk_fold(const int32_t* src, const int32_t* dst,
+                        const double* w, const uint8_t* valid, int64_t n,
+                        int32_t n_v, int32_t* partner, double* weight,
+                        uint8_t* ev_type, int32_t* ev_a, int32_t* ev_b,
+                        double* ev_w, int64_t ev_cap, int64_t* ev_count) {
+  int64_t ne = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) continue;
+    const int32_t u = src[i];
+    const int32_t v = dst[i];
+    if (u < 0 || u >= n_v || v < 0 || v >= n_v) return 2;
+    if (u == v) continue;
+    const int32_t pu = partner[u];
+    const int32_t pv = partner[v];
+    // Colliding matched edges: u's and v's. If u and v are matched to each
+    // other that is one edge, not two.
+    const bool same = (pu == v) && (pv == u);
+    const double coll_sum =
+        same ? weight[u]
+             : (pu >= 0 ? weight[u] : 0.0) + (pv >= 0 ? weight[v] : 0.0);
+    if (w[i] > 2.0 * coll_sum) {
+      const int32_t evict_x[2] = {u, v};
+      const int32_t evict_p[2] = {pu, pv};
+      const int n_evict = same ? 1 : 2;
+      for (int k = 0; k < n_evict; ++k) {
+        const int32_t x = evict_x[k];
+        const int32_t px = evict_p[k];
+        if (px >= 0) {
+          if (ev_type != nullptr) {
+            if (ne >= ev_cap) return 3;
+            ev_type[ne] = 1;  // REMOVE
+            ev_a[ne] = x;
+            ev_b[ne] = px;
+            ev_w[ne] = weight[x];
+            ++ne;
+          }
+          partner[px] = -1;
+          weight[px] = 0.0;
+          partner[x] = -1;
+          weight[x] = 0.0;
+        }
+      }
+      partner[u] = v;
+      partner[v] = u;
+      weight[u] = weight[v] = w[i];
+      if (ev_type != nullptr) {
+        if (ne >= ev_cap) return 3;
+        ev_type[ne] = 0;  // ADD
+        ev_a[ne] = u;
+        ev_b[ne] = v;
+        ev_w[ne] = w[i];
+        ++ne;
+      }
+    }
+  }
+  if (ev_count != nullptr) *ev_count = ne;
+  return 0;
+}
+
+}  // extern "C"

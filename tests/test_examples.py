@@ -59,6 +59,37 @@ def test_matching_eviction():
     ]
 
 
+def test_matching_native_fold_matches_python_fallback(monkeypatch):
+    """The C++ fold (native/matching.cc) and the Python host loop must
+    produce identical final matchings AND identical ordered event streams."""
+    import gelly_tpu.library.matching as M
+
+    rng = np.random.default_rng(11)
+    n_e, n_v = 2000, 128
+    edges = [
+        (int(a), int(b), float(w))
+        for a, b, w in zip(
+            rng.integers(0, n_v, n_e), rng.integers(0, n_v, n_e),
+            rng.integers(1, 500, n_e),
+        )
+    ]
+
+    def run():
+        s = edge_stream_from_edges(edges, vertex_capacity=n_v, chunk_size=64)
+        ws = weighted_matching(s)
+        evs = list(ws.events())
+        return evs, sorted(ws.final_matching())
+
+    monkeypatch.setattr(M, "_NATIVE", False)  # force the Python loop
+    evs_py, fin_py = run()
+    monkeypatch.setattr(M, "_NATIVE", None)  # re-probe the native kernel
+    if not M._native_ok():
+        pytest.skip("native toolchain unavailable")
+    evs_nat, fin_nat = run()
+    assert fin_nat == fin_py
+    assert evs_nat == evs_py
+
+
 def test_matching_half_approximation_bound():
     rng = np.random.default_rng(8)
     edges = [
