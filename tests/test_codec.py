@@ -251,3 +251,48 @@ def test_codec_emission_cadence():
     s = _stream(src, dst, chunk_size=64)  # 8 chunks
     out = list(s.aggregate(agg, mesh=mesh, merge_every=2, fold_batch=2))
     assert len(out) == 4
+
+
+# ---------------- degree codec (degrees.degree_aggregate) ---------------- #
+
+
+@pytest.mark.parametrize("with_deletions", [False, True])
+@pytest.mark.parametrize("count_out,count_in",
+                         [(True, True), (True, False), (False, True)])
+def test_degree_codec_parity(with_deletions, count_out, count_in):
+    """Codec path (host bincount deltas — incl. the insertion-only integer
+    fast path), plain device fold, and a dict oracle must all agree, with
+    partial final chunks and (optionally) deletion events in the mix."""
+    from gelly_tpu.library.degrees import degree_aggregate
+
+    rng = np.random.default_rng(5)
+    n_e = 300  # chunk_size 64 -> partial final chunk
+    src = rng.integers(0, N_V, n_e).astype(np.int64)
+    dst = rng.integers(0, N_V, n_e).astype(np.int64)
+    ev = np.zeros(n_e, np.int32)
+    if with_deletions:
+        # Delete a subset of earlier insertions (degrees may go negative on
+        # unmatched deletes; the oracle mirrors that semantics exactly).
+        ev[rng.random(n_e) < 0.2] = 1
+
+    def stream():
+        return edge_stream_from_source(
+            EdgeChunkSource(src, dst, events=ev, chunk_size=64,
+                            table=IdentityVertexTable(N_V)),
+            N_V,
+        )
+
+    oracle = np.zeros(N_V, np.int64)
+    sign = np.where(ev == 1, -1, 1)
+    if count_out:
+        np.add.at(oracle, src, sign)
+    if count_in:
+        np.add.at(oracle, dst, sign)
+
+    for ingest_combine, fold_batch in [(True, 1), (True, 4), (False, 1)]:
+        agg = degree_aggregate(N_V, count_out=count_out, count_in=count_in,
+                               ingest_combine=ingest_combine)
+        got = np.asarray(stream().aggregate(
+            agg, merge_every=4, fold_batch=fold_batch
+        ).result())
+        assert (got == oracle).all(), (ingest_combine, fold_batch)
