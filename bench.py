@@ -292,13 +292,16 @@ def bench_triangles(args):
     from gelly_tpu.core.io import EdgeChunkSource, TimeCharacteristic
     from gelly_tpu.core.stream import edge_stream_from_source
     from gelly_tpu.core.vertices import IdentityVertexTable
-    from gelly_tpu.library.triangles import window_triangles
 
     n_e = min(args.edges, 1_000_000)  # windowed wedge matching: bounded size
     n_v = min(args.vertices, 1 << 12)
     src, dst = synth_edges(n_e, n_v)
     ts = np.arange(n_e, dtype=np.int64)  # 10 windows
     window_ms = n_e // 10
+    # Window buffers are wire-padded to capacity; size them to the real
+    # window content (window_ms edges, doubled for the ALL-direction
+    # calibration the API expects) instead of chunk-size heuristics.
+    window_capacity = 1 << (2 * window_ms - 1).bit_length()
 
     def stream():
         return edge_stream_from_source(
@@ -309,10 +312,10 @@ def bench_triangles(args):
             n_v,
         )
 
-    from gelly_tpu.library.triangles import window_triangle_counts_device
+    from gelly_tpu.library.triangles import window_triangle_counts_batched
 
-    list(window_triangles(stream(), window_ms,
-                          window_capacity=2 * args.chunk_size))  # warmup
+    list(window_triangle_counts_batched(
+        stream(), window_ms, window_capacity=window_capacity))  # warmup
     import jax.numpy as jnp
 
     dt = float("inf")
@@ -320,8 +323,8 @@ def bench_triangles(args):
         t0 = time.perf_counter()
         # Keep per-window counts on device; one batched pull at the end
         # (each host sync costs ~100ms fixed latency on a tunneled TPU).
-        wins, counts = zip(*window_triangle_counts_device(
-            stream(), window_ms, window_capacity=2 * args.chunk_size))
+        wins, counts = zip(*window_triangle_counts_batched(
+            stream(), window_ms, window_capacity=window_capacity))
         counts = np.asarray(jnp.stack(counts))
         dt = min(dt, time.perf_counter() - t0)
     ours = dict(zip(wins, counts.tolist()))

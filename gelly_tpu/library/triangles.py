@@ -109,18 +109,31 @@ def _check_slot_range(capacity: int, full_capacity: int, *arrays_with_mask):
             )
 
 
-@partial(jax.jit, static_argnames=("n", "capacity", "method"))
+@partial(jax.jit, static_argnames=("n", "capacity", "method", "mirror"))
 def _window_triangle_count_packed(packed: jax.Array, n: int, capacity: int,
-                                  method: str) -> jax.Array:
+                                  method: str,
+                                  mirror: bool = False) -> jax.Array:
     """Packed-wire variant: ``packed[i] = key*n + nbr`` (INT_MAX padding).
 
     The window view's key/nbr/valid columns compress into one i32 on the
     host — the H2D transfer is the dominant window cost on a
-    bandwidth-limited link, and the triangle count never reads ``val``."""
+    bandwidth-limited link, and the triangle count never reads ``val``.
+
+    With ``mirror`` the packed column carries each window edge ONCE (the
+    OUT-direction buffer) and the ALL-direction doubled view the count
+    kernel expects is reconstructed on device — both directions of an edge
+    always share the edge's timestamp window, so symmetrizing after the
+    transfer is exact and halves the wire bytes again.
+    """
     valid = packed != segments.INT_MAX
     safe = jnp.where(valid, packed, 0)
     key = (safe // n).astype(jnp.int32)
     nbr = (safe % n).astype(jnp.int32)
+    if mirror:
+        key, nbr = (
+            jnp.concatenate([key, nbr]), jnp.concatenate([nbr, key])
+        )
+        valid = jnp.concatenate([valid, valid])
     view = NeighborhoodView(
         key=jnp.where(valid, key, segments.INT_MAX),
         nbr=nbr,
@@ -130,6 +143,52 @@ def _window_triangle_count_packed(packed: jax.Array, n: int, capacity: int,
         seg_id=jnp.zeros_like(key),  # unused by the count
     )
     return _window_triangle_count(view, capacity, method)
+
+
+def _pick_method(method: str, n: int):
+    """Resolve method="auto" per window: MXU for dense windows on TPU."""
+    if method != "auto":
+        return lambda view_len: method
+    from ..ops.pallas_kernels import on_tpu
+
+    tpu = on_tpu()
+    return lambda view_len: (
+        "mxu" if (view_len >= n and n % 128 == 0 and tpu) else "gather"
+    )
+
+
+def _packed_out_windows(stream, window_ms: int, window_capacity: int | None,
+                        n: int) -> Iterator[tuple[int, np.ndarray]]:
+    """(window, packed i32 host column) per closed window.
+
+    OUT-direction windows carry each edge once; the doubled ALL-direction
+    view the count kernel expects is rebuilt on device (mirror=True) — both
+    directions share the edge's timestamp window, so symmetrizing after the
+    transfer is exact and ships half the bytes of the undirected window
+    buffer. ``window_capacity`` is calibrated by callers for the doubled
+    ALL-direction buffer; the single-copy buffer needs half of it.
+    """
+    snap = stream.slice(
+        window_ms, "out",
+        window_capacity=None if window_capacity is None
+        else max(1, window_capacity // 2),
+    )
+    try:
+        for w, (bk, bn, _bv, bo) in snap.host_buffers():
+            _check_slot_range(n, stream.ctx.vertex_capacity,
+                              (bk, bo), (bn, bo))
+            yield w, np.where(
+                bo, bk.astype(np.int64) * n + bn, segments.INT_MAX
+            ).astype(np.int32)
+    except ValueError as e:
+        if "window buffer overflow" in str(e):
+            raise ValueError(
+                f"{e} — note: the packed triangle path stores each window "
+                "edge once and sizes its buffer as window_capacity // 2 "
+                "(window_capacity keeps the ALL-direction doubled-buffer "
+                "calibration)"
+            ) from e
+        raise
 
 
 def window_triangle_counts_device(stream, window_ms: int,
@@ -142,38 +201,94 @@ def window_triangle_counts_device(stream, window_ms: int,
     window; on a tunneled TPU a sync costs ~100ms of fixed latency).
 
     When the slot space fits (capacity^2 < 2^31) the window view ships as
-    ONE packed i32 column instead of key/nbr/val/valid — ~3x fewer wire
-    bytes for the dominant per-window transfer.
+    ONE packed i32 column per single-copy window edge instead of
+    key/nbr/val/valid — ~6x fewer wire bytes for the dominant per-window
+    transfer (see :func:`_packed_out_windows`).
     """
     n = capacity if capacity is not None else stream.ctx.vertex_capacity
-    snap = stream.slice(window_ms, "all", window_capacity=window_capacity)
-    pack = n * n < (1 << 31)
+    pick = _pick_method(method, n)
 
-    def pick(view_len):
-        if method != "auto":
-            return method
-        from ..ops.pallas_kernels import on_tpu
-
-        dense = view_len >= n and n % 128 == 0
-        return "mxu" if (dense and on_tpu()) else "gather"
-
-    if pack:
-        for w, (bk, bn, _bv, bo) in snap.host_buffers():
-            _check_slot_range(n, stream.ctx.vertex_capacity,
-                              (bk, bo), (bn, bo))
-            packed = np.where(
-                bo, bk.astype(np.int64) * n + bn, segments.INT_MAX
-            ).astype(np.int32)
+    if n * n < (1 << 31):
+        for w, packed in _packed_out_windows(
+            stream, window_ms, window_capacity, n
+        ):
             yield w, _window_triangle_count_packed(
-                packed, n, n, pick(packed.shape[0])
+                packed, n, n, pick(2 * packed.shape[0]), mirror=True
             )
         return
+    snap = stream.slice(window_ms, "all", window_capacity=window_capacity)
     for w, view in snap.views():
         _check_slot_range(
             n, stream.ctx.vertex_capacity,
             (view.key, view.valid), (view.nbr, view.valid),
         )
         yield w, _window_triangle_count(view, n, pick(view.key.shape[0]))
+
+
+@partial(jax.jit, static_argnames=("n", "capacity", "method"))
+def _window_triangle_count_packed_group(packed_kl: jax.Array, n: int,
+                                        capacity: int, method: str
+                                        ) -> jax.Array:
+    """Count triangles for a GROUP of packed windows in one dispatch.
+
+    ``packed_kl`` is ``i32[K, L]`` — K single-copy (mirror) window columns
+    stacked on the host. ``lax.map`` runs the per-window count sequentially
+    on device, so HBM holds one window's dense state at a time while the
+    host pays one transfer + one dispatch for the whole group (the same
+    fixed-cost amortization as the engine's ``fold_batch``).
+    """
+    return jax.lax.map(
+        lambda p: _window_triangle_count_packed(
+            p, n, capacity, method, mirror=True
+        ),
+        packed_kl,
+    )
+
+
+def window_triangle_counts_batched(stream, window_ms: int,
+                                   capacity: int | None = None,
+                                   window_capacity: int | None = None,
+                                   method: str = "auto",
+                                   batch: int = 4) -> Iterator[tuple]:
+    """Per-window counts with up to ``batch`` closed windows per device
+    dispatch: yields (window_index, device_scalar) like
+    :func:`window_triangle_counts_device` but amortizes the per-transfer
+    fixed cost over the group — the window-path analog of the engine's
+    ``fold_batch`` (emission latency grows by up to ``batch - 1`` windows;
+    the final partial group is padded with empty windows, which count 0).
+
+    Requires the packed wire format (capacity^2 < 2^31).
+    """
+    n = capacity if capacity is not None else stream.ctx.vertex_capacity
+    if n * n >= (1 << 31):
+        yield from window_triangle_counts_device(
+            stream, window_ms, capacity, window_capacity, method
+        )
+        return
+    pick = _pick_method(method, n)
+    group: list = []
+
+    def flush():
+        k = len(group)
+        wins = [w for w, _ in group]
+        cols = [c for _, c in group]
+        if k < batch:
+            cols += [np.full_like(cols[0], segments.INT_MAX)] * (batch - k)
+        stacked = np.stack(cols)
+        counts = _window_triangle_count_packed_group(
+            stacked, n, n, pick(2 * stacked.shape[1])
+        )
+        return list(zip(wins, [counts[i] for i in range(k)]))
+
+    for w, packed in _packed_out_windows(
+        stream, window_ms, window_capacity, n
+    ):
+        group.append((w, packed))
+        if len(group) == batch:
+            yield from flush()
+            group = []
+    if group:
+        yield from flush()
 
 
 def window_triangles(stream, window_ms: int, capacity: int | None = None,

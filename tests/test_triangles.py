@@ -53,6 +53,23 @@ def test_window_triangles_duplicate_edges_counted_once():
     assert dict(window_triangles(s, 1000)) == {0: 1}
 
 
+def test_window_triangles_batched_groups_match_per_window():
+    # The grouped-dispatch path (lax.map over stacked packed windows,
+    # padded final group) must equal the per-window path for every batch
+    # size, including batch > #windows and a partial final group.
+    import jax.numpy as jnp
+
+    from gelly_tpu.library.triangles import window_triangle_counts_batched
+
+    want = {0: 2, 1: 3, 2: 2}
+    for batch in (1, 2, 4, 8):
+        wins, counts = zip(*window_triangle_counts_batched(
+            triangles_stream(), 400, batch=batch
+        ))
+        got = dict(zip(wins, np.asarray(jnp.stack(counts)).tolist()))
+        assert got == want, batch
+
+
 def test_exact_triangle_count_full_graph():
     # All 19 edges, no windows: 9 triangles total
     # {1,2,3},{2,3,4},{3,4,5},{4,5,6},{5,6,7},{6,7,8},{7,8,9},{8,9,10},{9,10,11}
