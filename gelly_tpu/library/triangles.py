@@ -206,16 +206,15 @@ def window_triangle_counts_device(stream, window_ms: int,
     transfer (see :func:`_packed_out_windows`).
     """
     n = capacity if capacity is not None else stream.ctx.vertex_capacity
-    pick = _pick_method(method, n)
 
     if n * n < (1 << 31):
-        for w, packed in _packed_out_windows(
-            stream, window_ms, window_capacity, n
-        ):
-            yield w, _window_triangle_count_packed(
-                packed, n, n, pick(2 * packed.shape[0]), mirror=True
-            )
+        # The per-window path is the batch=1 degenerate of the grouped one
+        # (no added emission latency).
+        yield from window_triangle_counts_batched(
+            stream, window_ms, capacity, window_capacity, method, batch=1
+        )
         return
+    pick = _pick_method(method, n)
     snap = stream.slice(window_ms, "all", window_capacity=window_capacity)
     for w, view in snap.views():
         _check_slot_range(
@@ -257,7 +256,9 @@ def window_triangle_counts_batched(stream, window_ms: int,
     ``fold_batch`` (emission latency grows by up to ``batch - 1`` windows;
     the final partial group is padded with empty windows, which count 0).
 
-    Requires the packed wire format (capacity^2 < 2^31).
+    When the packed wire format is unavailable (capacity^2 >= 2^31) this
+    degrades to the unpacked per-window path — one transfer and dispatch
+    per window, no grouping.
     """
     n = capacity if capacity is not None else stream.ctx.vertex_capacity
     if n * n >= (1 << 31):

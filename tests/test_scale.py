@@ -1,0 +1,133 @@
+"""Scale / skew soak tests (VERDICT r1 weak #8).
+
+Zipf-skewed streams at 10^5-edge scale through the windowed and
+summary-aggregation pipelines, checked against vectorized host oracles.
+The CC codec soak lives in test_codec.py; these cover the window path
+(triangles — WindowTriangles.java semantics), the parity union-find
+(BipartitenessCheck.java — a single odd cycle deep in the stream must
+flip the sticky failure bit), and skewed degree streams with deletions
+(DegreeDistribution.java's ±1 semantics at scale).
+"""
+
+import numpy as np
+import pytest
+
+from gelly_tpu.core.io import EdgeChunkSource, TimeCharacteristic
+from gelly_tpu.core.stream import edge_stream_from_source
+from gelly_tpu.core.vertices import IdentityVertexTable
+
+
+def _zipf(rng, n, n_v):
+    return (rng.zipf(1.3, n) % n_v).astype(np.int64)
+
+
+def test_window_triangles_skewed_soak():
+    # 60k Zipf edges, 6 windows, batched dispatch path vs a per-window
+    # python set-intersection oracle. Skew concentrates edges on few hot
+    # vertices — the dense-window regime the MXU kernel targets (runs on
+    # the CPU backend here, same code path modulo the Pallas dispatch).
+    import jax.numpy as jnp
+
+    from gelly_tpu.library.triangles import window_triangle_counts_batched
+
+    rng = np.random.default_rng(23)
+    n_e, n_v = 60_000, 512
+    src, dst = _zipf(rng, n_e, n_v), _zipf(rng, n_e, n_v)
+    ts = np.arange(n_e, dtype=np.int64)
+    window_ms = n_e // 6
+
+    stream = edge_stream_from_source(
+        EdgeChunkSource(src, dst, timestamps=ts, chunk_size=1 << 13,
+                        table=IdentityVertexTable(n_v),
+                        time=TimeCharacteristic.EVENT),
+        n_v,
+    )
+    wins, counts = zip(*window_triangle_counts_batched(
+        stream, window_ms, window_capacity=4 * window_ms, batch=4
+    ))
+    got = dict(zip(wins, np.asarray(jnp.stack(counts)).tolist()))
+
+    base: dict[int, int] = {}
+    for w in range(0, n_e, window_ms):
+        adj: dict[int, set] = {}
+        seen: set = set()
+        for i in range(w, min(w + window_ms, n_e)):
+            a, b = int(src[i]), int(dst[i])
+            if a == b or (a, b) in seen or (b, a) in seen:
+                continue
+            seen.add((a, b))
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set()).add(a)
+        cnt = 0
+        for a, b in seen:
+            lo = min(a, b)
+            cnt += sum(1 for u in adj[a] & adj[b] if u < lo)
+        base[w // window_ms] = cnt
+    assert got == base
+    assert sum(got.values()) > 0  # the soak actually exercised triangles
+
+
+@pytest.mark.parametrize("conflict_at", [0.05, 0.95])
+def test_bipartiteness_late_conflict_soak(conflict_at):
+    # 200k-edge bipartite stream (edges only cross the two parts) with ONE
+    # odd edge injected at `conflict_at` of the stream: ok must flip there
+    # and stay flipped (Candidates.fail() is sticky, Candidates.java:194).
+    from gelly_tpu.library.bipartiteness import bipartiteness_check
+
+    rng = np.random.default_rng(29)
+    n_e, n_v = 200_000, 1 << 14
+    half = n_v // 2
+    a = rng.integers(0, half, n_e)  # part A: even slots
+    b = rng.integers(0, half, n_e)  # part B: odd slots
+    src = (2 * a).astype(np.int64)
+    dst = (2 * b + 1).astype(np.int64)
+    k = int(n_e * conflict_at)
+    # Odd edge: connects two part-A vertices already linked through B.
+    src[k], dst[k] = src[0], 2 * rng.integers(0, half)
+    if src[k] == dst[k]:
+        dst[k] = (dst[k] + 2) % n_v
+    # Guarantee both endpoints share a component: bridge them via B.
+    src[k - 1], dst[k - 1] = src[k], 1
+    src[k + 1], dst[k + 1] = dst[k], 1
+
+    def run(n_run):
+        stream = edge_stream_from_source(
+            EdgeChunkSource(src[:n_run], dst[:n_run], chunk_size=1 << 14,
+                            table=IdentityVertexTable(n_v)),
+            n_v,
+        )
+        res = stream.aggregate(
+            bipartiteness_check(n_v), merge_every=4, fold_batch=4
+        ).result()
+        return bool(res.ok)
+
+    assert run(k - 2) is True  # clean prefix: 2-colorable
+    assert run(n_e) is False  # odd cycle seen: sticky failure
+
+
+def test_degree_distribution_skewed_deletions_soak():
+    # 150k Zipf edges with 25% deletions through degree_aggregate's codec
+    # vs a signed-bincount oracle; hot vertices reach degrees ~10^4, the
+    # skew regime VERDICT flagged as untested.
+    from gelly_tpu.library.degrees import degree_aggregate
+
+    rng = np.random.default_rng(31)
+    n_e, n_v = 150_000, 1 << 13
+    src, dst = _zipf(rng, n_e, n_v), _zipf(rng, n_e, n_v)
+    ev = (rng.random(n_e) < 0.25).astype(np.int32)
+
+    stream = edge_stream_from_source(
+        EdgeChunkSource(src, dst, events=ev, chunk_size=1 << 14,
+                        table=IdentityVertexTable(n_v)),
+        n_v,
+    )
+    got = np.asarray(stream.aggregate(
+        degree_aggregate(n_v), merge_every=4, fold_batch=4
+    ).result())
+
+    sign = np.where(ev == 1, -1, 1)
+    oracle = np.zeros(n_v, np.int64)
+    np.add.at(oracle, src, sign)
+    np.add.at(oracle, dst, sign)
+    assert (got == oracle).all()
+    assert int(oracle.max()) > 1000  # the skew actually materialized
