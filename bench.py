@@ -319,7 +319,7 @@ def bench_triangles(args):
     import jax.numpy as jnp
 
     dt = float("inf")
-    for _ in range(2):  # best-of-2: damp shared-device variance
+    for _ in range(3):  # best-of-3: damp shared-device variance
         t0 = time.perf_counter()
         # Keep per-window counts on device; one batched pull at the end
         # (each host sync costs ~100ms fixed latency on a tunneled TPU).

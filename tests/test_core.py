@@ -115,15 +115,30 @@ def test_union(reference_edges):
     assert sorted(got) == sorted(reference_edges)
 
 
-def test_distinct():
+@pytest.mark.parametrize("device", [False, True])
+def test_distinct(device):
     # TestDistinct: duplicated input collapses to unique (src, dst) pairs.
+    # Duplicates land both within one chunk and across chunk boundaries
+    # (chunk_size=2); host and device paths must agree exactly.
     edges = [(1, 2, 1.0), (1, 2, 1.0), (2, 3, 1.0), (1, 2, 9.0), (3, 4, 1.0),
              (2, 3, 5.0)]
-    got = stream_of(edges, chunk_size=2).distinct().collect_edges()
+    got = stream_of(edges, chunk_size=2).distinct(device=device) \
+        .collect_edges()
     assert sorted((s, d) for s, d, _ in got) == [(1, 2), (2, 3), (3, 4)]
     # first-wins: the surviving (1,2) is the first one (val 1.0)
     vals = {(s, d): v for s, d, v in got}
     assert vals[(1, 2)] == 1.0
+
+
+def test_distinct_host_matches_device_random():
+    rng = np.random.default_rng(17)
+    edges = [(int(a), int(b), float(i))
+             for i, (a, b) in enumerate(rng.integers(0, 12, (300, 2)))]
+    host = stream_of(edges, chunk_size=32).distinct().collect_edges()
+    dev = stream_of(edges, chunk_size=32).distinct(device=True) \
+        .collect_edges()
+    assert sorted(host) == sorted(dev)
+    assert len(host) == len({(s, d) for s, d, _ in edges})
 
 
 def test_get_vertices(reference_edges):
