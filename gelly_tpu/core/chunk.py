@@ -11,9 +11,10 @@ fuseable by XLA.
 
 Each edge carries two id representations:
 
-- ``raw_src`` / ``raw_dst``: the external 64-bit vertex ids, which user UDFs
-  (mapEdges / filterEdges / filterVertices predicates) observe — matching the
-  reference where UDFs see the original ``K`` ids.
+- ``raw_src`` / ``raw_dst``: the external vertex ids (at their source
+  integer width, up to 64-bit), which user UDFs (mapEdges / filterEdges /
+  filterVertices predicates) observe — matching the reference where UDFs
+  see the original ``K`` ids.
 - ``src`` / ``dst``: dense ``i32`` slots assigned by a
   :class:`~gelly_tpu.core.vertices.VertexTable` at ingest; all summary kernels
   index fixed-shape state arrays with these. This replaces the reference's
@@ -40,7 +41,9 @@ class EdgeChunk(NamedTuple):
     Fields are always present so the pytree structure is static under jit:
 
     - ``src``, ``dst``: ``i32[C]`` dense vertex slots (padding entries are 0).
-    - ``raw_src``, ``raw_dst``: ``i64[C]`` external vertex ids.
+    - ``raw_src``, ``raw_dst``: external vertex ids at their source integer
+      width (``i64`` for file/table ingest; narrower for identity streams
+      whose source arrays already are).
     - ``val``: ``EV[C]`` or ``EV[C, k]`` edge values (default ``f32`` ones).
     - ``ts``: ``i64[C]`` event-time or ingestion-time timestamps (ms).
     - ``event``: ``i8[C]`` — 0 = addition, 1 = deletion (EventType equivalent).
@@ -160,12 +163,25 @@ def make_chunk(
         if a.dtype == dtype and a.shape[0] == cap:
             return a  # zero-copy fast path (full chunk, right dtype)
         a = a.astype(dtype, copy=False)
+        if a.shape[0] == cap:
+            return a  # full chunk, one dtype-conversion pass, no re-pad
         out = np.zeros((cap,) + a.shape[1:], dtype=dtype)
         out[:n] = a
         return out
 
-    raw_src = src if raw_src is None else raw_src
-    raw_dst = dst if raw_dst is None else raw_dst
+    raw_src = src if raw_src is None else np.asarray(raw_src)
+    raw_dst = dst if raw_dst is None else np.asarray(raw_dst)
+    # Raw ids keep their source integer width (i64 only when a source is
+    # i64): identity-table streams then slice raw fields zero-copy instead
+    # of astype-copying 16 bytes/edge on the ingest thread. Consumers see
+    # raw ids only through user fns / decode, which are width-agnostic.
+    # Both fields share the promoted width so a wider raw_dst never
+    # truncates.
+
+    def _int_width(a):
+        return a.dtype if np.issubdtype(a.dtype, np.integer) else np.int64
+
+    raw_dtype = np.promote_types(_int_width(raw_src), _int_width(raw_dst))
     if val is None:
         val = (
             _const(cap, "ones", val_dtype)
@@ -183,8 +199,8 @@ def make_chunk(
     return EdgeChunk(
         src=put(pad(src, np.int32)),
         dst=put(pad(dst, np.int32)),
-        raw_src=put(pad(raw_src, np.int64)),
-        raw_dst=put(pad(raw_dst, np.int64)),
+        raw_src=put(pad(raw_src, raw_dtype)),
+        raw_dst=put(pad(raw_dst, raw_dtype)),
         val=put(pad(val, np.dtype(val_dtype))),
         ts=put(pad(ts, np.int64)),
         event=put(event),

@@ -195,6 +195,21 @@ def test_deletion_events_decrement_degrees():
     assert list(make().number_of_edges())[-1] == 1
 
 
+def test_make_chunk_raw_width_promotion():
+    # Raw ids keep their source integer width, but a wider raw_dst must
+    # promote BOTH raw fields (an i64 id must never truncate through i32).
+    from gelly_tpu.core.chunk import make_chunk
+
+    c = make_chunk(np.array([0, 1], np.int32), np.array([1, 2], np.int32),
+                   raw_dst=np.array([2 ** 40, 3], np.int64), capacity=2,
+                   device=False)
+    assert c.raw_dst.dtype == np.int64 and int(c.raw_dst[0]) == 2 ** 40
+    assert c.raw_src.dtype == np.int64
+    c2 = make_chunk(np.array([0, 1], np.int32), np.array([1, 2], np.int32),
+                    capacity=4, device=False)
+    assert c2.raw_src.dtype == np.int32  # identity: no conversion pass
+
+
 def test_vertex_capacity_overflow_raises(reference_edges):
     s = stream_of(reference_edges, vertex_capacity=3)
     with pytest.raises(ValueError, match="overflow|capacity"):
