@@ -315,7 +315,8 @@ def bench_triangles(args):
     from gelly_tpu.library.triangles import window_triangle_counts_batched
 
     list(window_triangle_counts_batched(
-        stream(), window_ms, window_capacity=window_capacity))  # warmup
+        stream(), window_ms, window_capacity=window_capacity,
+        batch=8))  # warmup
     import jax.numpy as jnp
 
     dt = float("inf")
@@ -324,7 +325,8 @@ def bench_triangles(args):
         # Keep per-window counts on device; one batched pull at the end
         # (each host sync costs ~100ms fixed latency on a tunneled TPU).
         wins, counts = zip(*window_triangle_counts_batched(
-            stream(), window_ms, window_capacity=window_capacity))
+            stream(), window_ms, window_capacity=window_capacity,
+        batch=8))
         counts = np.asarray(jnp.stack(counts))
         dt = min(dt, time.perf_counter() - t0)
     ours = dict(zip(wins, counts.tolist()))
