@@ -212,8 +212,10 @@ def bench_degrees(args):
     if ds is not None:
         fsrc, fdst, _ = read_edge_list(ds)  # native C++ parser path
         reps = max(1, args.edges // fsrc.shape[0])
-        src = np.concatenate([fsrc] * reps)
-        dst = np.concatenate([fdst] * reps)
+        # Densify to i32 once at stream prep (ids fit the fixture's 4096-
+        # slot space): the identity table then slices chunks zero-copy.
+        src = np.concatenate([fsrc.astype(np.int32)] * reps)
+        dst = np.concatenate([fdst.astype(np.int32)] * reps)
         args = argparse.Namespace(**vars(args))
         args.vertices = 4096  # fixture id space, power-of-two capacity
         args.edges = src.shape[0]

@@ -61,6 +61,17 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
     def host_compress(chunk):
         m = np.asarray(chunk.valid)
         ev = np.asarray(chunk.event)
+        from ..utils import native
+
+        if native.degree_deltas_available():
+            # Single native pass over both endpoint columns
+            # (native/chunk_combiner.cc:degree_chunk_deltas), ~4x numpy's
+            # two bincounts; GIL released, so it overlaps the H2D wait.
+            return native.degree_chunk_deltas(
+                np.asarray(chunk.src), np.asarray(chunk.dst),
+                ev if ev.any() else None, None if m.all() else m,
+                n, count_out, count_in,
+            )
         all_valid = bool(m.all())
         # Insertion-only chunks (the common case) pass weights=None so
         # np.bincount takes its integer path — ~4.5x faster than the

@@ -91,8 +91,26 @@ def _load_combiner() -> ctypes.CDLL:
             _i32p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int32,
             _i32p, _u8p, _i32p,
         ]
+        # Bound separately: a prebuilt .so that predates this symbol (no
+        # source/compiler to rebuild from) must only disable the degree
+        # codec, not the CC/parity combiners above.
+        try:
+            lib.degree_chunk_deltas.restype = ctypes.c_int
+            lib.degree_chunk_deltas.argtypes = [
+                _i32p, _i32p, ctypes.POINTER(ctypes.c_int8), _u8p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, _i32p,
+            ]
+            lib._has_degree_deltas = True
+        except AttributeError:
+            lib._has_degree_deltas = False
         lib._sigs_set = True
     return lib
+
+
+def degree_deltas_available() -> bool:
+    """The chunk-combiner library loads AND exports degree_chunk_deltas."""
+    return available("chunk_combiner") and _load_combiner()._has_degree_deltas
 
 
 def _as_i32p(a: np.ndarray):
@@ -235,6 +253,38 @@ def parity_chunk_combine(src: np.ndarray, dst: np.ndarray,
             f"parity_chunk_combine: vertex slot out of range (rc={rc})"
         )
     return labels, parity, bool(conflict.value)
+
+
+def degree_chunk_deltas(src: np.ndarray, dst: np.ndarray,
+                        event: np.ndarray | None, valid: np.ndarray | None,
+                        n_v: int, count_out: bool = True,
+                        count_in: bool = True) -> np.ndarray:
+    """Dense ±1 endpoint-degree delta vector i32[n_v] of one chunk.
+
+    ``event`` (i8, 1 = deletion) and ``valid`` may be None (all additions /
+    all valid). ctypes releases the GIL during the call.
+    """
+    lib = _load_combiner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    out = np.empty((n_v,), np.int32)
+    ep = None
+    if event is not None:
+        event = np.ascontiguousarray(event, np.int8)
+        ep = event.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    rc = lib.degree_chunk_deltas(
+        _as_i32p(src), _as_i32p(dst), ep, vp, src.shape[0], n_v,
+        int(count_out), int(count_in), _as_i32p(out),
+    )
+    if rc != 0:
+        raise ValueError(
+            f"degree_chunk_deltas: vertex slot out of range (rc={rc})"
+        )
+    return out
 
 
 def parse_edge_list_file(path: str, want_vals: bool = False):

@@ -142,4 +142,37 @@ int parity_chunk_combine(const int32_t* src, const int32_t* dst,
   return 0;
 }
 
+// Degree-delta codec: one pass over the chunk accumulating the ±1 endpoint
+// deltas (EventType deletions subtract) into a dense i32[n_v] vector — the
+// degree equivalent of the forest payloads above (DegreeMapFunction
+// semantics, .../SimpleEdgeStream.java:461-478, with DegreeDistribution's
+// ±1 deletion handling, .../example/DegreeDistribution.java:70-79). The
+// n_v-sized delta vector is what ships over the wire instead of the edges.
+//
+//   event : optional i8[n] (null = all additions), 1 = deletion
+//   valid : optional u8[n] mask (null = all valid)
+//
+// Returns 0 on success, 2 on a slot outside [0, n_v).
+int degree_chunk_deltas(const int32_t* src, const int32_t* dst,
+                        const int8_t* event, const uint8_t* valid,
+                        int64_t n, int32_t n_v, int32_t count_out,
+                        int32_t count_in, int32_t* out) {
+  std::memset(out, 0, sizeof(int32_t) * static_cast<size_t>(n_v));
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) continue;
+    const int32_t d = (event != nullptr && event[i] == 1) ? -1 : 1;
+    if (count_out) {
+      const int32_t u = src[i];
+      if (u < 0 || u >= n_v) return 2;
+      out[u] += d;
+    }
+    if (count_in) {
+      const int32_t v = dst[i];
+      if (v < 0 || v >= n_v) return 2;
+      out[v] += d;
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"
