@@ -456,17 +456,26 @@ def bench_matching(args):
     from gelly_tpu.library.matching import weighted_matching
 
     ds = _dataset("ratings_like.txt")
+    # The native fold runs ~20M edges/s, so a big enough stream is needed
+    # for a stable timed region; the python baseline loop doubles as the
+    # full-stream parity oracle, which bounds the practical size.
     if ds is not None:
         fsrc, fdst, fval = read_edge_list(ds, num_value_cols=1)
-        reps = max(1, min(args.edges, 100_000) // fsrc.shape[0])
-        src = np.concatenate([fsrc] * reps)
-        dst = np.concatenate([fdst] * reps)
+        reps = max(1, min(args.edges, 4_000_000) // fsrc.shape[0])
+        # Each repetition permutes the id space (a fresh isomorphic
+        # instance): verbatim repeats would mostly no-op through the
+        # matcher and flatter the measured rate.
+        rng = np.random.default_rng(11)
+        perms = [rng.permutation(4096).astype(np.int32)
+                 for _ in range(reps)]
+        src = np.concatenate([p[fsrc] for p in perms])
+        dst = np.concatenate([p[fdst] for p in perms])
         w = np.concatenate([fval] * reps)
         args = argparse.Namespace(**vars(args))
         args.vertices = 4096
         n_e = src.shape[0]
     else:
-        n_e = min(args.edges, 200_000)  # sequential workload: bounded size
+        n_e = min(args.edges, 2_000_000)  # sequential workload: bounded
         src, dst = synth_edges(n_e, args.vertices)
         rng = np.random.default_rng(3)
         w = rng.integers(1, 1000, n_e).astype(np.float64)
@@ -479,10 +488,12 @@ def bench_matching(args):
         )
 
     weighted_matching(stream()).final()  # warmup
-    t0 = time.perf_counter()
-    ours = {(a, b): wt for a, b, wt in
-            weighted_matching(stream()).final_matching()}
-    dt = time.perf_counter() - t0
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ours = {(a, b): wt for a, b, wt in
+                weighted_matching(stream()).final_matching()}
+        dt = min(dt, time.perf_counter() - t0)
 
     t0 = time.perf_counter()
     matching: dict[int, tuple] = {}  # endpoint -> (a, b, w)
