@@ -26,9 +26,12 @@ def stream_from_args(args, vertex_capacity=1 << 16, chunk_size=4096,
             args[0], vertex_capacity=vertex_capacity, chunk_size=chunk_size,
             num_value_cols=num_value_cols, **kw,
         )
+    # Built-in default data is tiny; cap the chunk at its length so
+    # sequential per-slot folds (e.g. the spanner insert scan) don't pay
+    # for padding slots.
     return edge_stream_from_edges(
         default_edges, vertex_capacity=vertex_capacity,
-        chunk_size=min(chunk_size, 256), **kw,
+        chunk_size=min(chunk_size, 256, max(1, len(default_edges))), **kw,
     )
 
 

@@ -18,9 +18,11 @@ DEFAULT = [
 
 def main(args):
     # The spanner summary is a dense N^2 adjacency per shard: size the slot
-    # space to the graph, not the generic default (4 GB at 64k slots).
+    # space to the graph, not the generic default (4 GB at 64k slots; 16
+    # slots cover the built-in 9-vertex default).
     stream = stream_from_args(
-        args, default_edges=DEFAULT, vertex_capacity=1 << 12
+        args, default_edges=DEFAULT,
+        vertex_capacity=(1 << 12) if args else 16,
     )
     merge_every = arg(args, 1, 4)
     k = arg(args, 2, 3)
