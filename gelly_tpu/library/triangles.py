@@ -376,6 +376,12 @@ def _exact_step(state: TriangleCounts, chunk) -> TriangleCounts:
     (ExactTriangleCount.java:74-116) — but whole slabs of edges intersect
     at once as masked [slab, N] row ops instead of one scan iteration per
     edge. All accumulation is integer (no float roundoff at any capacity).
+
+    Measured on a 100k-edge / 1k-vertex stream on the TPU chip: ~286M
+    edges/s vs ~58k edges/s for the literal per-edge scan
+    (:func:`_exact_step_scan`, kept as the parity oracle) — the scan pays
+    one dispatch-latency-bound step per edge; the slab path is one fused
+    program per chunk.
     """
     n = state.adj.shape[0]
     cap = chunk.capacity
