@@ -115,3 +115,41 @@ def test_window_mode_checkpoint_is_chunk_consistent(tmp_path):
             agg, window_ms=100, checkpoint_path=p, resume=True
         ).result()
         assert labels_to_components(resumed, s2.ctx) == expected, cut
+
+
+def test_resume_midstream_codec_batched_plan(tmp_path):
+    # Resume must also be exact under the default CC plan at depth: the
+    # ingest codec (host_compress payloads) with fold_batch > 1 and a
+    # multi-chunk merge cadence. Interrupt after a prefix, resume over the
+    # full stream, compare with an uninterrupted run.
+    p = str(tmp_path / "cc_codec.npz")
+    rng = np.random.default_rng(41)
+    n_v, n_e = 256, 3000
+    edges = [(int(a), int(b), 1.0) for a, b in rng.integers(0, n_v, (n_e, 2))]
+
+    def stream(upto=None):
+        return edge_stream_from_edges(
+            edges[:upto], vertex_capacity=n_v, chunk_size=128,
+        )
+
+    agg = connected_components(n_v)
+    kw = dict(merge_every=4, fold_batch=4)
+
+    want_stream = stream()
+    want = labels_to_components(
+        want_stream.aggregate(agg, **kw).result(), want_stream.ctx
+    )
+
+    # Interrupted prefix run: 14 chunks end in a partial merge window, so
+    # the final (forced end-of-stream) checkpoint records position 14 —
+    # the resumed run re-enters mid-cadence, exercising skip_until with
+    # the codec's batched groups.
+    stream(14 * 128).aggregate(agg, checkpoint_path=p, **kw).result()
+    _, pos, _ = load_checkpoint(p, like=agg.init())
+    assert pos == 14
+
+    s2 = stream()
+    final = s2.aggregate(
+        agg, checkpoint_path=p, resume=True, **kw
+    ).result()
+    assert labels_to_components(final, s2.ctx) == want
