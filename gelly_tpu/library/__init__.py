@@ -12,7 +12,7 @@ from .connected_components import (
 from .degrees import degree_distribution, sharded_degrees
 from .iterative_cc import IterativeCCStream
 from .matching import weighted_matching
-from .spanner import spanner, spanner_edges
+from .spanner import host_spanner, spanner, spanner_edges
 from .triangles import (
     exact_triangle_count,
     sampled_triangle_count,

@@ -251,6 +251,78 @@ def spanner(vertex_capacity: int, k: int,
     )
 
 
+class HostSpannerStream:
+    """Centralized native host spanner — the fast path for the
+    order-dependent fold (the weighted-matching precedent: a strictly
+    sequential scalar state machine runs ~1000x faster as a native host
+    stage than as a per-edge device scan; measured 4.9k edges/s dense /
+    0.4k sparse on device vs multi-M edges/s here).
+
+    Gate semantics and the capped-degree adjacency layout are identical to
+    :func:`sparse_spanner`'s device summary (conservative degree-cap
+    degradation included); with ``max_degree`` at least the spanner's true
+    max degree the accepted edge list equals the dense device path's
+    exactly (same stream order, same gate).
+    """
+
+    def __init__(self, stream, k: int, max_degree: int = 64,
+                 max_edges: int | None = None):
+        from ..utils import native
+
+        if not native.available("spanner"):
+            raise RuntimeError(
+                "native spanner kernel unavailable (no toolchain); use "
+                "spanner()/sparse_spanner() through stream.aggregate()"
+            )
+        self.stream = stream
+        self.k = k
+        self.max_degree = max_degree
+        n = stream.ctx.vertex_capacity
+        self.e_cap = max_edges if max_edges is not None else 4 * n
+        self._nbr = np.full((n, max_degree), -1, np.int32)
+        self._deg = np.zeros((n,), np.int32)
+        self._stamp = np.zeros((n,), np.int32)
+        self._meta = np.zeros((3,), np.int64)
+        self._esrc = np.zeros((self.e_cap,), np.int32)
+        self._edst = np.zeros((self.e_cap,), np.int32)
+        self._drained = False
+
+    def _drain(self):
+        if self._drained:
+            return
+        from ..utils.native import spanner_chunk_fold
+
+        n = self.stream.ctx.vertex_capacity
+        for c in self.stream:
+            h = c.to_numpy()
+            spanner_chunk_fold(
+                h.src, h.dst, h.valid, n, self.k, self.max_degree,
+                self._nbr, self._deg, self._stamp, self._meta,
+                self._esrc, self._edst,
+            )
+        self._drained = True
+
+    @property
+    def deg_overflow(self) -> int:
+        """Row inserts dropped by the degree cap (each can only make the
+        spanner accept extra edges, never break the stretch bound)."""
+        self._drain()
+        return int(self._meta[2])
+
+    def final_edges(self) -> list[tuple[int, int]]:
+        """Accepted edges as raw-id pairs, insertion order."""
+        self._drain()
+        m = int(self._meta[1])
+        src = self.stream.ctx.decode(self._esrc[:m])
+        dst = self.stream.ctx.decode(self._edst[:m])
+        return list(zip(src.tolist(), dst.tolist()))
+
+
+def host_spanner(stream, k: int, max_degree: int = 64,
+                 max_edges: int | None = None) -> HostSpannerStream:
+    return HostSpannerStream(stream, k, max_degree, max_edges)
+
+
 def spanner_edges(summary, ctx) -> list[tuple[int, int]]:
     """Decode the accepted edge list to raw-id pairs (the reference's
     flattened adjacency printout, SpannerExample.java:139-153).

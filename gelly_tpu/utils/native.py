@@ -11,7 +11,10 @@ shared objects next to the source; no pip/pybind dependency) and exposes
   emitting a dense spanning-forest label array for compressed H2D transfer
   (``native/chunk_combiner.cc``);
 - :func:`matching_chunk_fold` — the centralized greedy weighted-matching
-  stage folded natively over one chunk (``native/matching.cc``).
+  stage folded natively over one chunk (``native/matching.cc``);
+- :func:`spanner_chunk_fold` — the order-dependent k-spanner gate
+  (bounded BFS per edge) folded natively over one chunk
+  (``native/spanner.cc``).
 
 Import failures (no compiler, read-only tree) degrade gracefully: callers
 fall back to pure-numpy implementations.
@@ -132,6 +135,7 @@ def available(stem: str) -> bool:
             "edgelist_parser": _load,
             "chunk_combiner": _load_combiner,
             "matching": _load_matching,
+            "spanner": _load_spanner,
         }[stem]
         try:
             loader()
@@ -139,6 +143,58 @@ def available(stem: str) -> bool:
         except (OSError, subprocess.SubprocessError, AttributeError):
             _AVAILABLE[stem] = False
     return _AVAILABLE[stem]
+
+
+def _load_spanner() -> ctypes.CDLL:
+    lib = _load_lib("spanner")
+    if not getattr(lib, "_sigs_set", False):
+        lib.spanner_chunk_fold.restype = ctypes.c_int
+        lib.spanner_chunk_fold.argtypes = [
+            _i32p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            _i32p, _i32p, _i32p, ctypes.POINTER(ctypes.c_int64),
+            _i32p, _i32p, ctypes.c_int64,
+        ]
+        lib._sigs_set = True
+    return lib
+
+
+def spanner_chunk_fold(src: np.ndarray, dst: np.ndarray,
+                       valid: np.ndarray | None, n_v: int, k: int,
+                       max_degree: int, nbr: np.ndarray, deg: np.ndarray,
+                       stamp: np.ndarray, meta: np.ndarray,
+                       out_src: np.ndarray, out_dst: np.ndarray) -> None:
+    """Fold one chunk into the host spanner state, in stream order.
+
+    ``nbr`` (i32[n_v, max_degree]), ``deg``/``stamp`` (i32[n_v]) and
+    ``meta`` (i64[3]: stamp counter, accepted count, degree overflows) are
+    mutated in place; accepted edges append to ``out_src``/``out_dst`` at
+    ``meta[1]``. Raises on slot range errors or output-list overflow.
+    ctypes releases the GIL during the call.
+    """
+    lib = _load_spanner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    for a, dt in ((nbr, np.int32), (deg, np.int32), (stamp, np.int32),
+                  (meta, np.int64), (out_src, np.int32),
+                  (out_dst, np.int32)):
+        assert a.dtype == dt and a.flags.c_contiguous
+    rc = lib.spanner_chunk_fold(
+        _as_i32p(src), _as_i32p(dst), vp, src.shape[0], n_v, k, max_degree,
+        _as_i32p(nbr), _as_i32p(deg), _as_i32p(stamp),
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        _as_i32p(out_src), _as_i32p(out_dst), out_src.shape[0],
+    )
+    if rc == 3:
+        raise ValueError(
+            "spanner edge list overflowed; raise max_edges"
+        )
+    if rc != 0:
+        raise ValueError(f"spanner_chunk_fold: bad vertex slot (rc={rc})")
 
 
 def _load_matching() -> ctypes.CDLL:

@@ -1,0 +1,115 @@
+// Native greedy k-spanner fold (centralized host stage).
+//
+// The reference's spanner keeps an edge iff its endpoints are not already
+// within k hops of each other in the spanner built so far
+// (/root/reference/src/main/java/org/apache/flink/graph/streaming/library/
+// Spanner.java:70-77, boundedBFS in summaries/AdjacencyListGraph.java:79-116).
+// The per-edge decision is order-dependent and strictly sequential, the
+// same scalar-state-machine shape as the weighted-matching stage — so the
+// hot fold belongs on the host: the device lax.scan pays a k-round
+// frontier expansion over the whole adjacency per edge (~5k edges/s),
+// while this kernel runs a bounded BFS over capped-degree rows per edge.
+//
+// State is owned by the caller as flat arrays (mutated in place), matching
+// the sparse device summary's layout so results are comparable:
+//   nbr   : i32[n_v * max_degree] adjacency rows, -1 = empty
+//   deg   : i32[n_v]
+//   stamp : i32[n_v]  BFS visit stamps, init 0
+//   meta  : i64[3]    {stamp_counter, n_accepted, deg_overflow}
+//
+// Degree-cap overflows drop the row insert and count it (meta[2]) — the
+// adjacency then under-reports reachability, which can only ACCEPT an
+// extra edge, never reject wrongly, so the k-stretch bound survives (the
+// same conservative degradation as the sparse device path).
+//
+// Exposed via ctypes (gelly_tpu/utils/native.py); no pybind dependency.
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+
+namespace {
+
+// dist(u, v) <= k over capped-degree rows: depth-bounded BFS with stamp
+// marking; q is scratch of n_v slots (always written before read).
+inline bool within_k(const int32_t* nbr, const int32_t* deg, int32_t* stamp,
+                     int32_t cur, int32_t max_degree, int32_t u, int32_t v,
+                     int32_t k, int32_t* q) {
+  if (u == v) return true;
+  int64_t head = 0, tail = 0;
+  q[tail++] = u;
+  stamp[u] = cur;
+  int64_t level_end = tail;
+  int32_t depth = 0;
+  while (head < tail && depth < k) {
+    const int32_t x = q[head++];
+    const int32_t* row = nbr + static_cast<int64_t>(x) * max_degree;
+    const int32_t dx = deg[x];
+    for (int32_t j = 0; j < dx; ++j) {
+      const int32_t y = row[j];
+      if (y == v) return true;
+      if (stamp[y] != cur) {
+        stamp[y] = cur;
+        q[tail++] = y;
+      }
+    }
+    if (head == level_end) {  // finished this BFS level
+      ++depth;
+      level_end = tail;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fold one chunk of edges into the spanner, in stream order. Accepted
+// edges are appended to out_src/out_dst starting at meta[1].
+//
+// Returns 0 on success, 2 on a slot outside [0, n_v), 3 when the output
+// edge list is full (sticky: the caller records overflow; the adjacency
+// was NOT updated for the overflowing edge, so state stays consistent
+// with the emitted list).
+int spanner_chunk_fold(const int32_t* src, const int32_t* dst,
+                       const uint8_t* valid, int64_t n, int32_t n_v,
+                       int32_t k, int32_t max_degree,
+                       int32_t* nbr, int32_t* deg, int32_t* stamp,
+                       int64_t* meta,
+                       int32_t* out_src, int32_t* out_dst, int64_t out_cap) {
+  // Uninitialized scratch: every q slot is written before it is read, and
+  // zero-filling n_v ints per chunk call is pure waste at N >= 1M.
+  std::unique_ptr<int32_t[]> q(new int32_t[static_cast<size_t>(n_v)]);
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) continue;
+    const int32_t u = src[i];
+    const int32_t v = dst[i];
+    if (u < 0 || u >= n_v || v < 0 || v >= n_v) return 2;
+    if (u == v) continue;
+    // Stamp space: reset before wrap (stamps are i32; one per query).
+    if (meta[0] >= INT32_MAX - 1) {
+      std::memset(stamp, 0, sizeof(int32_t) * static_cast<size_t>(n_v));
+      meta[0] = 0;
+    }
+    const int32_t cur = static_cast<int32_t>(++meta[0]);
+    if (within_k(nbr, deg, stamp, cur, max_degree, u, v, k, q.get())) continue;
+    if (meta[1] >= out_cap) return 3;
+    out_src[meta[1]] = u;
+    out_dst[meta[1]] = v;
+    ++meta[1];
+    for (int t = 0; t < 2; ++t) {
+      const int32_t a = t ? v : u;
+      const int32_t b = t ? u : v;
+      if (deg[a] < max_degree) {
+        nbr[static_cast<int64_t>(a) * max_degree + deg[a]] = b;
+        ++deg[a];
+      } else {
+        ++meta[2];
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
