@@ -117,13 +117,15 @@ class NeighborhoodView(NamedTuple):
 # jitted window-buffer plumbing (module-level for jit cache reuse)
 
 
-def _assemble_buffer(parts, capacity: int, val_dtype, val_shape=()):
+def _assemble_buffer(parts, capacity: int, val_dtype, val_shape=(),
+                     sort: bool = True):
     """Host-side window assembly: compact each chunk's valid entries with
     numpy boolean indexing, pack into one padded buffer, and key-sort on
     the host. One H2D per window instead of per-chunk device scatters plus
     a device bitonic sort — numpy's radix argsort on ≤100k keys is ~20x
     faster than the TPU sort at these sizes, and the sorted buffer uploads
-    once."""
+    once. ``sort=False`` skips the key sort for consumers whose kernels
+    are order-independent (the packed triangle count)."""
     bk = np.full((capacity,), segments.INT_MAX, np.int32)  # padding sorts last
     bn = np.zeros((capacity,), np.int32)
     bv = np.zeros((capacity,) + val_shape, np.dtype(val_dtype))
@@ -138,10 +140,11 @@ def _assemble_buffer(parts, capacity: int, val_dtype, val_shape=()):
         bv[fill:fill2] = np.asarray(c.val)[m]
         bo[fill:fill2] = True
         fill = fill2
-    order = np.argsort(bk[:fill], kind="stable")
-    bk[:fill] = bk[:fill][order]
-    bn[:fill] = bn[:fill][order]
-    bv[:fill] = bv[:fill][order]
+    if sort:
+        order = np.argsort(bk[:fill], kind="stable")
+        bk[:fill] = bk[:fill][order]
+        bn[:fill] = bn[:fill][order]
+        bv[:fill] = bv[:fill][order]
     return bk, bn, bv, bo
 
 
@@ -184,11 +187,12 @@ class SnapshotStream:
             else:
                 yield c
 
-    def host_buffers(self) -> Iterator[tuple[int, tuple]]:
+    def host_buffers(self, sort: bool = True) -> Iterator[tuple[int, tuple]]:
         """(window, (key, nbr, val, valid)) per closed window with HOST
-        numpy arrays — sorted by key, padding keys = INT_MAX. The escape
-        hatch for consumers bringing their own wire codec (e.g. the
-        packed window-triangle path): nothing is uploaded here."""
+        numpy arrays — sorted by key (unless ``sort=False``), padding keys
+        = INT_MAX. The escape hatch for consumers bringing their own wire
+        codec (e.g. the packed window-triangle path): nothing is uploaded
+        here."""
         from .windows import tumbling_window_events
 
         self.stats["late_edges"] = 0
@@ -202,7 +206,7 @@ class SnapshotStream:
             if kind == "close":
                 c0 = parts[0]
                 yield w, _assemble_buffer(
-                    parts, cap, c0.val.dtype, c0.val.shape[1:]
+                    parts, cap, c0.val.dtype, c0.val.shape[1:], sort=sort
                 )
                 self.stats["windows_closed"] += 1
                 parts = []

@@ -258,11 +258,13 @@ class HostSpannerStream:
     stage than as a per-edge device scan; measured 4.9k edges/s dense /
     0.4k sparse on device vs multi-M edges/s here).
 
-    Gate semantics and the capped-degree adjacency layout are identical to
-    :func:`sparse_spanner`'s device summary (conservative degree-cap
-    degradation included); with ``max_degree`` at least the spanner's true
-    max degree the accepted edge list equals the dense device path's
-    exactly (same stream order, same gate).
+    With ``max_degree`` at least the spanner's true max degree the accepted
+    edge list equals the dense device path's exactly (same stream order,
+    same gate). Under a binding degree cap both this and
+    :func:`sparse_spanner` degrade conservatively (extra accepted edges,
+    never a broken stretch bound) but not identically: the device sparse
+    gate also bounds its BFS frontier (``frontier_cap``), which can
+    under-report reachability in cases this exact bounded BFS does not.
     """
 
     def __init__(self, stream, k: int, max_degree: int = 64,
@@ -286,20 +288,33 @@ class HostSpannerStream:
         self._esrc = np.zeros((self.e_cap,), np.int32)
         self._edst = np.zeros((self.e_cap,), np.int32)
         self._drained = False
+        self._failed: Exception | None = None
 
     def _drain(self):
         if self._drained:
             return
+        if self._failed is not None:
+            # A partial fold corrupted nothing, but re-draining would:
+            # EdgeStream.__iter__ restarts the stream, and re-folding it
+            # into the already-populated state double-inserts. Fail fast.
+            raise RuntimeError(
+                "spanner fold previously failed; build a new "
+                "HostSpannerStream (with a larger max_edges) and re-run"
+            ) from self._failed
         from ..utils.native import spanner_chunk_fold
 
         n = self.stream.ctx.vertex_capacity
-        for c in self.stream:
-            h = c.to_numpy()
-            spanner_chunk_fold(
-                h.src, h.dst, h.valid, n, self.k, self.max_degree,
-                self._nbr, self._deg, self._stamp, self._meta,
-                self._esrc, self._edst,
-            )
+        try:
+            for c in self.stream:
+                h = c.to_numpy()
+                spanner_chunk_fold(
+                    h.src, h.dst, h.valid, n, self.k, self.max_degree,
+                    self._nbr, self._deg, self._stamp, self._meta,
+                    self._esrc, self._edst,
+                )
+        except Exception as e:
+            self._failed = e
+            raise
         self._drained = True
 
     @property

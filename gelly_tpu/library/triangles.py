@@ -174,7 +174,8 @@ def _packed_out_windows(stream, window_ms: int, window_capacity: int | None,
         else max(1, window_capacity // 2),
     )
     try:
-        for w, (bk, bn, _bv, bo) in snap.host_buffers():
+        # The count kernel is order-independent; skip the key sort.
+        for w, (bk, bn, _bv, bo) in snap.host_buffers(sort=False):
             _check_slot_range(n, stream.ctx.vertex_capacity,
                               (bk, bo), (bn, bo))
             yield w, np.where(

@@ -215,3 +215,21 @@ def test_host_spanner_properties_at_scale(k):
     # Zipf hubs overflow a 32-slot row cap; the counter must have seen it
     # (the stretch property above held anyway — conservative degradation).
     assert h.deg_overflow > 0
+
+
+@pytest.mark.skipif(not _toolchain(), reason="native toolchain unavailable")
+def test_host_spanner_overflow_poisons_state():
+    # An edge-list overflow mid-stream must fail fast on every later
+    # access — re-draining a fresh stream iterator into half-folded state
+    # would silently corrupt the spanner.
+    from gelly_tpu.library.spanner import host_spanner
+
+    edges = [(i, i + 1, 1.0) for i in range(40)]  # path: every edge kept
+    s = edge_stream_from_edges(edges, vertex_capacity=64, chunk_size=8)
+    h = host_spanner(s, 2, max_degree=8, max_edges=10)
+    with pytest.raises(ValueError, match="overflow"):
+        h.final_edges()
+    with pytest.raises(RuntimeError, match="previously failed"):
+        h.final_edges()
+    with pytest.raises(RuntimeError, match="previously failed"):
+        h.deg_overflow
