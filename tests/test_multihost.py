@@ -54,6 +54,93 @@ _WORKER = textwrap.dedent(
 
 
 def test_initialize_multihost_two_processes(tmp_path):
+    _run_two_process(_WORKER, "MULTIHOST_OK")
+
+
+_CC_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)
+    sys.path.insert(0, os.environ["REPO_ROOT"])
+    import jax
+    import numpy as np
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.initialize_multihost(
+        coordinator_address=os.environ["COORD"],
+        num_processes=2,
+        process_id=int(os.environ["PID_IDX"]),
+    )
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gelly_tpu.library.connected_components import cc_labels_numpy
+    from gelly_tpu.ops import unionfind
+    from gelly_tpu.parallel import collectives
+
+    # The deployment shape: each host folds ITS OWN edge partition
+    # locally (ingest never crosses hosts), then the label forests merge
+    # over the distributed transport — keyBy/window fold per host +
+    # timeWindowAll fan-in across hosts (SummaryBulkAggregation.java:76-83),
+    # with the fan-in as a butterfly over the global mesh.
+    n_v = 64
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, n_v, 300).astype(np.int32)
+    dst = rng.integers(0, n_v, 300).astype(np.int32)
+    pid = jax.process_index()
+    lab = cc_labels_numpy(src[pid::2], dst[pid::2], None, n_v)
+    parent = np.where(lab >= 0, lab, np.arange(n_v)).astype(np.int32)
+    seen = lab >= 0
+
+    m = mesh_lib.make_mesh()  # global mesh: one device per process
+    sh = NamedSharding(m, P(mesh_lib.SHARD_AXIS))
+    g_parent = jax.make_array_from_callback(
+        (2, n_v), sh, lambda idx: jnp.asarray(parent[None, :]))
+    g_seen = jax.make_array_from_callback(
+        (2, n_v), sh, lambda idx: jnp.asarray(seen[None, :]))
+
+    def merge(parent_blk, seen_blk):
+        def comb(a, b):
+            return (unionfind.merge_forests(a[0][0], b[0][0])[None],
+                    a[1] | b[1])
+        return collectives.butterfly_merge(comb, (parent_blk, seen_blk), 2)
+
+    sh_spec = P(mesh_lib.SHARD_AXIS)
+    out_parent, out_seen = mesh_lib.shard_map_fn(
+        m, merge, in_specs=(sh_spec, sh_spec),
+        out_specs=(sh_spec, sh_spec),
+    )(g_parent, g_seen)
+    got_parent = np.asarray(
+        jax.device_get(out_parent.addressable_shards[0].data)
+    )[0]
+    got_seen = np.asarray(
+        jax.device_get(out_seen.addressable_shards[0].data)
+    )[0]
+
+    # Single-process oracle over the full stream.
+    full = cc_labels_numpy(src, dst, None, n_v)
+
+    def comps(parent, seen):
+        out = {}
+        for v in np.nonzero(seen)[0].tolist():
+            r = v
+            while parent[r] != r:
+                r = parent[r]
+            out.setdefault(r, set()).add(v)
+        return sorted(sorted(c) for c in out.values())
+
+    got = comps(got_parent, got_seen)
+    want = comps(np.where(full >= 0, full, np.arange(n_v)), full >= 0)
+    assert got == want, (got[:3], want[:3])
+    print("MULTIHOST_CC_OK", jax.process_index())
+    """
+)
+
+
+def _run_two_process(worker: str, token: str,
+                     timeout: float = 120):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -67,22 +154,25 @@ def test_initialize_multihost_two_processes(tmp_path):
         )
         env.pop("XLA_FLAGS", None)
         env.pop("PYTHONPATH", None)
-        # -I (isolated): ignore PYTHONPATH/user-site entirely so no site
-        # hook (e.g. a TPU plugin) can initialize the XLA backend before
-        # jax.distributed.initialize; the worker re-adds the repo itself.
         procs.append(subprocess.Popen(
-            [sys.executable, "-I", "-c", _WORKER], env=env,
+            [sys.executable, "-I", "-c", worker], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         ))
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=90)
+            out, err = p.communicate(timeout=timeout)
             outs.append((p.returncode, out, err))
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail("multihost smoke run timed out")
+        pytest.fail("multihost run timed out")
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout={out}\nstderr={err}"
-        assert "MULTIHOST_OK" in out
+        assert token in out
+
+
+def test_multihost_cc_merge_two_processes(tmp_path):
+    # Per-host local fold + cross-host butterfly label merge == the
+    # single-process result (identical final components).
+    _run_two_process(_CC_WORKER, "MULTIHOST_CC_OK")
