@@ -233,3 +233,56 @@ def test_host_spanner_overflow_poisons_state():
         h.final_edges()
     with pytest.raises(RuntimeError, match="previously failed"):
         h.deg_overflow
+
+
+@pytest.mark.skipif(not _toolchain(), reason="native toolchain unavailable")
+def test_spanner_ingest_codec_single_chunk_exact():
+    # One chunk spanning the whole stream: the chunk-local spanner equals
+    # the stream-order spanner, and re-gating it into an empty global
+    # reproduces the same decisions — codec result == plain result.
+    rng = np.random.default_rng(6)
+    n_v = 64
+    edges = [(int(a), int(b), 1.0)
+             for a, b in rng.integers(0, n_v, (400, 2))]
+
+    def run(**kw):
+        s = edge_stream_from_edges(edges, vertex_capacity=n_v,
+                                   chunk_size=512)
+        summ = s.aggregate(
+            spanner(n_v, 3, **kw), mesh=mesh_lib.make_mesh(1),
+            merge_every=4,
+        ).result()
+        return spanner_edges(summ, s.ctx)
+
+    assert run(ingest_combine=True, payload_cap=256) == run()
+
+
+@pytest.mark.skipif(not _toolchain(), reason="native toolchain unavailable")
+@pytest.mark.parametrize("sparse", [False, True])
+def test_spanner_ingest_codec_multichunk_stretch(sparse):
+    # Multi-chunk codec: each re-gate level relaxes the bound by a factor
+    # of k; this single-shard single-merge-window run has two levels
+    # (chunk-local gate + device re-gate) — assert subset + k^2 stretch.
+    rng = np.random.default_rng(15)
+    n_v = 96
+    edges = [(int(a), int(b), 1.0)
+             for a, b in rng.integers(0, n_v, (600, 2)) if a != b]
+    k = 2
+    kw = dict(ingest_combine=True, max_edges=1024, payload_cap=256)
+    if sparse:
+        kw["max_degree"] = 32
+    s = edge_stream_from_edges(edges, vertex_capacity=n_v, chunk_size=64)
+    summ = s.aggregate(
+        spanner(n_v, k, **kw), mesh=mesh_lib.make_mesh(1), merge_every=4,
+        fold_batch=4,
+    ).result()
+    got = spanner_edges(summ, s.ctx)
+    eset = {frozenset(e) for e in ((a, b) for a, b, _ in edges)}
+    for e in got:
+        assert frozenset(e) in eset
+    adj: dict = {}
+    for a, b in got:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+    for a, b, _ in edges:
+        assert bfs_dist(adj, a, b) <= k * k, (a, b)
