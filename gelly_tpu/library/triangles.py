@@ -145,6 +145,89 @@ def _window_triangle_count_packed(packed: jax.Array, n: int, capacity: int,
     return _window_triangle_count(view, capacity, method)
 
 
+@partial(jax.jit, static_argnames=("n", "max_degree", "slab"))
+def _window_triangle_count_sparse(key: jax.Array, nbr: jax.Array,
+                                  valid: jax.Array, n: int,
+                                  max_degree: int,
+                                  slab: int | None = None):
+    """Window triangle count over a capped-degree row table — the large-N
+    path (the dense kernel's ``bool[N, N]`` adjacency is infeasible past
+    N ~ 46k, where the packed wire format also stops fitting i32).
+
+    Input is the single-copy OUT-direction window (key, nbr, valid);
+    the doubled view is built in-kernel. The window's (deduped) adjacency
+    is scattered into ``i32[N, D]`` neighbor rows (ranks from a sorted
+    segment scan), and each canonical edge (a < b) counts common
+    neighbors u < a by a slab-mapped D x D row intersection — same
+    candidate/match semantics as the dense kernel
+    (WindowTriangles.java:82-139).
+
+    Returns ``(count i64, overflow i32)`` — overflow is the number of
+    adjacency entries dropped by the degree cap; the caller must treat
+    any overflow as an error (a dropped entry could hide triangles).
+    """
+    D = max_degree
+    if slab is None:
+        # Bound the [slab, D, D] intersection tensor (same sizing rule as
+        # the sparse exact stream).
+        slab = max(8, (1 << 22) // max(1, D * D))
+    k2 = jnp.concatenate([key, nbr])
+    n2 = jnp.concatenate([nbr, key])
+    ok = jnp.concatenate([valid, valid]) & (k2 != n2)
+    # Sort by (key, nbr): duplicates become adjacent, rows fill ascending.
+    pack = jnp.where(
+        ok, k2.astype(jnp.int64) * n + n2.astype(jnp.int64),
+        jnp.iinfo(jnp.int64).max,
+    )
+    order = jnp.argsort(pack)
+    sk, sn, so, sp = k2[order], n2[order], ok[order], pack[order]
+    fresh = segments.segment_starts(sp, so)  # drop duplicate directed pairs
+    run = segments.segment_starts(
+        jnp.where(so, sk, segments.INT_MAX), so
+    )
+    # Rank among fresh entries within each key run: cumulative fresh count
+    # minus the run's base, propagated from the run start (cumsum is
+    # monotone, so a running max carries the latest run's base forward).
+    cf = jnp.cumsum(fresh.astype(jnp.int32))
+    base = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(run, cf - fresh.astype(jnp.int32), 0)
+    )
+    rank = cf - fresh.astype(jnp.int32) - base
+    fits = fresh & (rank < D)
+    overflow = jnp.sum((fresh & ~fits).astype(jnp.int32))
+    table = jnp.full((n, D), -1, jnp.int32)
+    table = table.at[
+        jnp.where(fits, sk, n), jnp.minimum(rank, D - 1)
+    ].set(sn, mode="drop")
+
+    # One canonical lane per undirected window edge.
+    canon = fresh & (sk < sn)
+    L2 = sk.shape[0]
+    pad = (-L2) % slab
+    csk = jnp.pad(sk, (0, pad))
+    csn = jnp.pad(sn, (0, pad))
+    cok = jnp.pad(canon, (0, pad))
+    S = csk.shape[0] // slab
+
+    def body(args):
+        a_id, b_id, live = args  # [slab] each
+        rows_a = table[jnp.where(live, a_id, 0)]  # [slab, D]
+        rows_b = table[jnp.where(live, b_id, 0)]
+        m = (
+            (rows_a[:, :, None] == rows_b[:, None, :])
+            & (rows_a[:, :, None] >= 0)
+            # wedge-min convention: count centers u < a = min(a, b)
+            & (rows_a[:, :, None] < a_id[:, None, None])
+        )
+        per = jnp.sum(m, axis=(1, 2))
+        return jnp.sum(jnp.where(live, per, 0).astype(jnp.int64))
+
+    counts = jax.lax.map(body, (
+        csk.reshape(S, slab), csn.reshape(S, slab), cok.reshape(S, slab)
+    ))
+    return jnp.sum(counts), overflow
+
+
 def _pick_method(method: str, n: int):
     """Resolve method="auto" per window: MXU for dense windows on TPU."""
     if method != "auto":
@@ -157,16 +240,17 @@ def _pick_method(method: str, n: int):
     )
 
 
-def _packed_out_windows(stream, window_ms: int, window_capacity: int | None,
-                        n: int) -> Iterator[tuple[int, np.ndarray]]:
-    """(window, packed i32 host column) per closed window.
+def _out_windows(stream, window_ms: int, window_capacity: int | None,
+                 n: int) -> Iterator[tuple[int, tuple]]:
+    """(window, (key, nbr, valid) host columns) per closed window.
 
     OUT-direction windows carry each edge once; the doubled ALL-direction
-    view the count kernel expects is rebuilt on device (mirror=True) — both
-    directions share the edge's timestamp window, so symmetrizing after the
-    transfer is exact and ships half the bytes of the undirected window
-    buffer. ``window_capacity`` is calibrated by callers for the doubled
-    ALL-direction buffer; the single-copy buffer needs half of it.
+    view the count kernels expect is rebuilt on device (mirror) — both
+    directions share the edge's timestamp window, so symmetrizing after
+    the transfer is exact and ships half the bytes of the undirected
+    window buffer. ``window_capacity`` is calibrated by callers for the
+    doubled ALL-direction buffer; the single-copy buffer needs half of
+    it. Unsorted (the count kernels are order-independent).
     """
     snap = stream.slice(
         window_ms, "out",
@@ -174,22 +258,30 @@ def _packed_out_windows(stream, window_ms: int, window_capacity: int | None,
         else max(1, window_capacity // 2),
     )
     try:
-        # The count kernel is order-independent; skip the key sort.
         for w, (bk, bn, _bv, bo) in snap.host_buffers(sort=False):
             _check_slot_range(n, stream.ctx.vertex_capacity,
                               (bk, bo), (bn, bo))
-            yield w, np.where(
-                bo, bk.astype(np.int64) * n + bn, segments.INT_MAX
-            ).astype(np.int32)
+            yield w, (bk, bn, bo)
     except ValueError as e:
         if "window buffer overflow" in str(e):
             raise ValueError(
-                f"{e} — note: the packed triangle path stores each window "
-                "edge once and sizes its buffer as window_capacity // 2 "
+                f"{e} — note: the triangle paths store each window "
+                "edge once and size their buffer as window_capacity // 2 "
                 "(window_capacity keeps the ALL-direction doubled-buffer "
                 "calibration)"
             ) from e
         raise
+
+
+def _packed_out_windows(stream, window_ms: int, window_capacity: int | None,
+                        n: int) -> Iterator[tuple[int, np.ndarray]]:
+    """(window, packed i32 host column): key*n + nbr, INT_MAX padding —
+    half the wire bytes of separate columns (requires n^2 < 2^31)."""
+    for w, (bk, bn, bo) in _out_windows(stream, window_ms,
+                                        window_capacity, n):
+        yield w, np.where(
+            bo, bk.astype(np.int64) * n + bn, segments.INT_MAX
+        ).astype(np.int32)
 
 
 def window_triangle_counts_device(stream, window_ms: int,
@@ -245,11 +337,25 @@ def _window_triangle_count_packed_group(packed_kl: jax.Array, n: int,
     )
 
 
+@partial(jax.jit, static_argnames=("n", "max_degree"))
+def _window_triangle_count_sparse_group(keys_kl, nbrs_kl, valids_kl,
+                                        n: int, max_degree: int):
+    """(counts i64[K], overflows i32[K]) for K stacked sparse windows."""
+    return jax.lax.map(
+        lambda t: _window_triangle_count_sparse(
+            t[0], t[1], t[2], n, max_degree
+        ),
+        (keys_kl, nbrs_kl, valids_kl),
+    )
+
+
 def window_triangle_counts_batched(stream, window_ms: int,
                                    capacity: int | None = None,
                                    window_capacity: int | None = None,
                                    method: str = "auto",
-                                   batch: int = 4) -> Iterator[tuple]:
+                                   batch: int = 4,
+                                   max_degree: int | None = None
+                                   ) -> Iterator[tuple]:
     """Per-window counts with up to ``batch`` closed windows per device
     dispatch: yields (window_index, device_scalar) like
     :func:`window_triangle_counts_device` but amortizes the per-transfer
@@ -257,20 +363,79 @@ def window_triangle_counts_batched(stream, window_ms: int,
     ``fold_batch`` (emission latency grows by up to ``batch - 1`` windows;
     the final partial group is padded with empty windows, which count 0).
 
-    When the packed wire format is unavailable (capacity^2 >= 2^31) this
-    degrades to the unpacked per-window path — one transfer and dispatch
-    per window, no grouping.
+    ``max_degree`` selects the capped-degree sparse kernel
+    (:func:`_window_triangle_count_sparse`) — the ONLY path for large
+    vertex capacities (the dense kernel's bool[N, N] adjacency and the
+    packed i32 wire format both stop at N ~ 46k). Degree-cap overflow
+    raises (a dropped adjacency entry could hide triangles; raise
+    ``max_degree`` to the window's true max degree).
+
+    Without ``max_degree``, capacities with capacity^2 >= 2^31 degrade to
+    the unpacked dense per-window path — one transfer and dispatch per
+    window, no grouping, and infeasible memory past N ~ 46k.
     """
     n = capacity if capacity is not None else stream.ctx.vertex_capacity
-    if n * n >= (1 << 31):
+    if max_degree is None and n * n >= (1 << 31):
         yield from window_triangle_counts_device(
             stream, window_ms, capacity, window_capacity, method
         )
         return
-    pick = _pick_method(method, n)
-    group: list = []
 
-    def flush():
+    def in_groups(it):
+        group: list = []
+        for item in it:
+            group.append(item)
+            if len(group) == batch:
+                yield group
+                group = []
+        if group:
+            yield group
+
+    if max_degree is not None:
+        # Overflow checks are deferred by one group (and finalized after
+        # the loop): pulling the overflow scalar immediately would sync
+        # the host per group and forfeit the pipelining this path exists
+        # for (same pattern as the sparse exact stream).
+        pending = None  # (overs device array, k)
+
+        def check(p):
+            if p is None:
+                return
+            overs, k = p
+            overs = np.asarray(overs)
+            if overs[:k].any():
+                raise ValueError(
+                    f"window adjacency rows overflowed max_degree="
+                    f"{max_degree} ({int(overs[:k].sum())} entries "
+                    "dropped); raise max_degree"
+                )
+
+        def flush(group):
+            k = len(group)
+            wins = [w for w, _ in group]
+            cols = [c for _, c in group]
+            if k < batch:
+                empty = tuple(np.zeros_like(a) for a in cols[0])
+                cols += [empty] * (batch - k)
+            kk, nn, vv = (np.stack(x) for x in zip(*cols))
+            counts, overs = _window_triangle_count_sparse_group(
+                kk, nn, vv, n, max_degree
+            )
+            return list(zip(wins, [counts[i] for i in range(k)])), (overs, k)
+
+        for group in in_groups(
+            _out_windows(stream, window_ms, window_capacity, n)
+        ):
+            out, overs = flush(group)
+            check(pending)
+            pending = overs
+            yield from out
+        check(pending)
+        return
+
+    pick = _pick_method(method, n)
+
+    def flush(group):
         k = len(group)
         wins = [w for w, _ in group]
         cols = [c for _, c in group]
@@ -282,20 +447,16 @@ def window_triangle_counts_batched(stream, window_ms: int,
         )
         return list(zip(wins, [counts[i] for i in range(k)]))
 
-    for w, packed in _packed_out_windows(
-        stream, window_ms, window_capacity, n
+    for group in in_groups(
+        _packed_out_windows(stream, window_ms, window_capacity, n)
     ):
-        group.append((w, packed))
-        if len(group) == batch:
-            yield from flush()
-            group = []
-    if group:
-        yield from flush()
+        yield from flush(group)
 
 
 def window_triangles(stream, window_ms: int, capacity: int | None = None,
                      window_capacity: int | None = None,
-                     method: str = "auto") -> Iterator[tuple]:
+                     method: str = "auto",
+                     max_degree: int | None = None) -> Iterator[tuple]:
     """Per-window triangle counts: yields (window_index, count).
 
     The reference emits (count, window.maxTimestamp) per window
@@ -304,8 +465,17 @@ def window_triangles(stream, window_ms: int, capacity: int | None = None,
 
     ``method``: "gather" (VPU, sparse windows), "mxu" (Pallas matmul, dense
     windows; needs capacity % 128 == 0), or "auto" (mxu on TPU when the
-    window buffer is dense relative to capacity).
+    window buffer is dense relative to capacity). ``max_degree`` selects
+    the capped-degree sparse kernel — required for large vertex
+    capacities (see :func:`window_triangle_counts_batched`).
     """
+    if max_degree is not None:
+        for w, c in window_triangle_counts_batched(
+            stream, window_ms, capacity, window_capacity, method,
+            batch=1, max_degree=max_degree,
+        ):
+            yield w, int(c)
+        return
     for w, c in window_triangle_counts_device(
         stream, window_ms, capacity, window_capacity, method
     ):
