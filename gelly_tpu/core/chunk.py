@@ -147,6 +147,13 @@ def make_chunk(
     round-trips — the right mode for ingest sources. Full chunks (n ==
     capacity) of already-right-dtype arrays are zero-copy views; default
     val/event/valid fields are shared cached constants.
+
+    No-mutation contract: on the zero-copy fast path the returned chunk
+    ALIASES the caller's arrays (numpy offers no way to write-protect the
+    caller's buffer through a view). A source must therefore not reuse or
+    mutate its input buffers after yielding a chunk built from them — the
+    chunk may still be in flight on the prefetch/ingest pipeline. Sources
+    that recycle buffers must pass copies.
     """
     src = np.asarray(src)
     dst = np.asarray(dst)

@@ -104,6 +104,11 @@ class EdgeChunkSource:
     - ``time`` = EVENT: ``timestamps`` (or ``ts_fn(src_raw, dst_raw, val)``)
       supplies event time, assumed ascending like the reference's
       ``AscendingTimestampExtractor`` (ctor #2).
+
+    Yielded chunks are zero-copy SLICES of the input arrays (and of one
+    whole-stream dense encode): see :func:`make_chunk`'s no-mutation
+    contract — callers must not mutate ``src_raw``/``dst_raw``/``val``
+    after construction while chunks may still be in flight.
     """
 
     def __init__(

@@ -323,7 +323,12 @@ def run_aggregation(
     aggregation defines an ingest codec (``host_compress``/
     ``fold_compressed``), batches are compressed payload stacks instead of
     raw chunks — the high-throughput path on a bandwidth-limited
-    host->device link.
+    host->device link. Sharded-codec floor: with a codec on S > 1 shards
+    the payload batch axis is split across devices, so the effective batch
+    is promoted to a multiple of S — in particular ``fold_batch=1`` with
+    ``merge_every % S == 0`` silently becomes ``batch=S`` (S stacked
+    payloads per dispatch: more per-dispatch host memory/latency than
+    requested, but the only aligned batching).
 
     ``timer`` (a ``utils.metrics.StageTimer``) accumulates per-stage
     wall-clock: ``ingest_compress`` / ``h2d`` (prefetch thread),

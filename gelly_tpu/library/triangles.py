@@ -368,7 +368,11 @@ def window_triangle_counts_batched(stream, window_ms: int,
     vertex capacities (the dense kernel's bool[N, N] adjacency and the
     packed i32 wire format both stop at N ~ 46k). Degree-cap overflow
     raises (a dropped adjacency entry could hide triangles; raise
-    ``max_degree`` to the window's true max degree).
+    ``max_degree`` to the window's true max degree). The overflow check is
+    deferred by one group to preserve pipelining, so up to ``batch`` counts
+    from the overflowing group may be yielded (corrupt) before the raise —
+    consumers acting per yield must not treat yielded counts as final until
+    the next iteration step (or ``StopIteration``) succeeds.
 
     Without ``max_degree``, capacities with capacity^2 >= 2^31 degrade to
     the unpacked dense per-window path — one transfer and dispatch per
@@ -651,7 +655,14 @@ def exact_triangle_count(stream, capacity: int | None = None,
 
     ``max_degree=None`` → dense arrival-index matrix (O(N^2) memory, the
     small-N fast path); ``max_degree=D`` → capped-degree sparse table
-    (O(N*D) memory, the N >= 1M path; degree overflow raises)."""
+    (O(N*D) memory, the N >= 1M path; degree overflow raises).
+
+    Overflow contract (sparse path): overflow checks are deferred by one
+    chunk to preserve dispatch pipelining, so the iterator may yield ONE
+    state whose counts are corrupt before raising ``ValueError``. Consumers
+    acting per yield should gate on the yielded ``state.overflow`` scalar
+    (0 = clean); ``final()``/``final_counts()`` never observe a corrupt
+    state (the raise fires first)."""
     if max_degree is not None:
         return SparseExactTriangleStream(stream, max_degree, capacity)
     return ExactTriangleStream(stream, capacity)
@@ -716,7 +727,10 @@ def _row_append(nbr, aidx, deg, overflow, key, val, idx, ok, max_degree):
     aidx = aidx.reshape(-1).at[flat].set(idx[order], mode="drop").reshape(
         n, max_degree
     )
-    deg = segments.masked_scatter_add(deg, key, jnp.ones_like(key), ok)
+    # Count only inserts that actually landed (mirrors ops/rowtable.row_insert):
+    # deg must equal the row fill so any deg-based row slicing stays valid;
+    # dropped inserts are recorded solely in ``overflow``.
+    deg = segments.masked_scatter_add(deg, k_s, jnp.ones_like(k_s), fits)
     return nbr, aidx, deg, overflow
 
 
