@@ -86,12 +86,68 @@ class SummaryAggregation:
     # per-edge timestamps).
     host_compress: Callable[[EdgeChunk], Any] | None = None
     fold_compressed: Callable[[Summary, Any], Summary] | None = None
+    # Optional payload stacker for variable-length codec payloads:
+    # ``stack_payloads(list_of_payloads) -> stacked pytree`` (leading axis
+    # K). Sparse touched-slot codecs use it to pad each batch to a
+    # power-of-two bucket capacity (wire bytes track the actual touched
+    # count; the handful of bucket shapes keep jit retraces bounded).
+    # None = leaves are equal-shape and np.stack-ed generically.
+    stack_payloads: Callable[[list], Any] | None = None
     # SummaryTreeReduce's degree knob (M/SummaryTreeReduce.java:75): when
     # set, the cross-shard combine runs as a two-phase hierarchical tree —
     # groups of S/degree shards merge first (ICI-local), then across groups
     # (DCN on multi-host meshes). None = flat butterfly / gather merge.
     merge_degree: int | None = None
     name: str = "aggregation"
+
+
+# Auto-codec threshold: below this slot-space size a dense per-chunk
+# payload (n_v * ~4 bytes) is smaller/cheaper than touched-slot pairs;
+# above it the dense payload inverts the codec's wire compression.
+SPARSE_CODEC_MIN_CAPACITY = 1 << 20
+
+
+def resolve_sparse_codec(codec: str, vertex_capacity: int) -> bool:
+    """Shared ``codec=`` knob semantics for the ingest codecs: validate
+    and resolve ``"auto"``/``"dense"``/``"sparse"`` to a bool (sparse?).
+    """
+    if codec not in ("auto", "dense", "sparse"):
+        raise ValueError(f"codec must be auto/dense/sparse, got {codec}")
+    return codec == "sparse" or (
+        codec == "auto" and vertex_capacity >= SPARSE_CODEC_MIN_CAPACITY
+    )
+
+
+def bucket_stack_payloads(payloads: list, pad_values: dict,
+                          min_bucket: int = 1024) -> dict:
+    """Stack variable-length dict payloads to a shared power-of-two bucket.
+
+    ``pad_values`` maps the variable-length array keys to their padding
+    value; those leaves are padded to ``max(min_bucket,
+    next_pow2(longest))`` before stacking, so the stacked shape (and hence
+    the jitted fold program) takes only O(log) distinct values across a
+    stream. Keys not in ``pad_values`` (per-payload scalars/fixed shapes)
+    are stacked as-is. This is the wire format of the sparse touched-slot
+    codecs: payload bytes ∝ the chunk's actual touched count, never the
+    vertex capacity.
+    """
+    longest = max(
+        (p[k].shape[0] for p in payloads for k in pad_values), default=0
+    )
+    cap = max(min_bucket, 1 << max(0, longest - 1).bit_length())
+    out = {}
+    for key in payloads[0]:
+        if key in pad_values:
+            stacked = np.full(
+                (len(payloads), cap), pad_values[key],
+                dtype=payloads[0][key].dtype,
+            )
+            for i, p in enumerate(payloads):
+                stacked[i, : p[key].shape[0]] = p[key]
+            out[key] = stacked
+        else:
+            out[key] = np.stack([p[key] for p in payloads])
+    return out
 
 
 def edges_fold_adapter(fold_edges: Callable, *, with_value: bool = True):
@@ -537,9 +593,12 @@ def run_aggregation(
                     payloads = [agg.host_compress(c) for c in group]
                     if k < batch:
                         payloads += [identity_payload] * (batch - k)
-                    stacked = jax.tree.map(
-                        lambda *ls: np.stack(ls), *payloads
-                    )
+                    if agg.stack_payloads is not None:
+                        stacked = agg.stack_payloads(payloads)
+                    else:
+                        stacked = jax.tree.map(
+                            lambda *ls: np.stack(ls), *payloads
+                        )
                     if S > 1:
                         # [K, ...] -> [S, K/S, ...]: chunk-data-parallel
                         # split of the batch axis across devices.

@@ -68,6 +68,32 @@ def parity_labels_numpy(src: np.ndarray, dst: np.ndarray,
     return labels, parity, conflict
 
 
+def parity_pairs_numpy(src: np.ndarray, dst: np.ndarray,
+                       valid: np.ndarray | None, n_v: int):
+    """Pure-numpy fallback for the native sparse parity combiner: counted
+    (vertex, root, parity) triples + chunk odd-cycle flag — work and
+    payload proportional to touched vertices, never ``n_v``."""
+    if valid is not None:
+        m = np.asarray(valid, bool)
+        src, dst = np.asarray(src)[m], np.asarray(dst)[m]
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    empty = (np.empty(0, np.int32), np.empty(0, np.int32),
+             np.empty(0, np.uint8), False)
+    if src.size == 0:
+        return empty
+    ids = np.unique(np.concatenate([src, dst]))
+    if ids[0] < 0 or ids[-1] >= n_v:
+        raise ValueError("parity_pairs_numpy: vertex slot out of range")
+    ls = np.searchsorted(ids, src)
+    ld = np.searchsorted(ids, dst)
+    labels, parity, conflict = parity_labels_numpy(
+        ls, ld, None, ids.shape[0]
+    )
+    return (ids.astype(np.int32), ids[labels].astype(np.int32),
+            parity.astype(np.uint8), conflict)
+
+
 class BipartitenessResult(NamedTuple):
     ok: jax.Array  # bool[] — graph (still) 2-colorable
     labels: jax.Array  # i32[N] component label (min slot), -1 unseen
@@ -75,13 +101,24 @@ class BipartitenessResult(NamedTuple):
 
 
 def bipartiteness_check(vertex_capacity: int,
-                        ingest_combine: bool = True) -> SummaryAggregation:
+                        ingest_combine: bool = True,
+                        codec: str = "auto") -> SummaryAggregation:
     """``ingest_combine`` (default on) attaches the ingest codec: chunks are
     pre-reduced on the host to (spanning forest, parity, conflict) — the
     native parity union-find combiner (native/chunk_combiner.cc) — and the
     device unions the parity-carrying star constraints. Same H2D compression
-    rationale as the CC codec."""
+    rationale as the CC codec.
+
+    ``codec``: ``"dense"`` (i32[n_v] labels + u8[n_v] parity per chunk) /
+    ``"sparse"`` (counted (vertex, root, parity) triples — payload and
+    host work ∝ touched vertices) / ``"auto"`` (sparse iff
+    ``vertex_capacity >= SPARSE_CODEC_MIN_CAPACITY``); see
+    :func:`~gelly_tpu.library.connected_components.connected_components`.
+    """
+    from ..engine.aggregation import resolve_sparse_codec
+
     n = vertex_capacity
+    sparse = resolve_sparse_codec(codec, n)
 
     def init() -> BipartiteSummary:
         return BipartiteSummary(
@@ -154,14 +191,60 @@ def bipartiteness_check(vertex_capacity: int,
         )
         return BipartiteSummary(forest, s.seen | present)
 
+    def host_compress_sparse(chunk) -> dict:
+        from ..utils import native
+
+        if native.sparse_codecs_available():
+            v, r, p, conflict = native.parity_chunk_combine_sparse(
+                np.asarray(chunk.src), np.asarray(chunk.dst),
+                np.asarray(chunk.valid), n,
+            )
+        else:
+            v, r, p, conflict = parity_pairs_numpy(
+                chunk.src, chunk.dst, chunk.valid, n
+            )
+        return {"v": v, "r": r, "p": p.astype(np.int8),
+                "conflict": np.bool_(conflict)}
+
+    def stack_sparse(payloads: list) -> dict:
+        from ..engine.aggregation import bucket_stack_payloads
+
+        return bucket_stack_payloads(payloads, {"v": -1, "r": 0, "p": 0})
+
+    def fold_compressed_sparse(s: BipartiteSummary,
+                               payload) -> BipartiteSummary:
+        # payload: K chunks' counted (vertex, root, parity) triples,
+        # -1-padded, + [K] chunk-local conflict flags.
+        v = payload["v"].reshape(-1)
+        ok = v >= 0
+        vi = jnp.where(ok, v, 0)
+        q = payload["p"].reshape(-1).astype(jnp.int32)
+        forest = puf.union_edges_parity(
+            s.forest._replace(
+                failed=s.forest.failed | jnp.any(payload["conflict"])
+            ),
+            vi, payload["r"].reshape(-1), q, ok,
+        )
+        seen = segments.mark_seen(s.seen, vi, ok)
+        return BipartiteSummary(forest, seen)
+
     return SummaryAggregation(
         init=init,
         fold=fold,
         combine=combine,
         transform=transform,
         merge_stacked=merge_stacked,
-        host_compress=host_compress if ingest_combine else None,
-        fold_compressed=fold_compressed if ingest_combine else None,
+        host_compress=(
+            (host_compress_sparse if sparse else host_compress)
+            if ingest_combine else None
+        ),
+        fold_compressed=(
+            (fold_compressed_sparse if sparse else fold_compressed)
+            if ingest_combine else None
+        ),
+        stack_payloads=(
+            stack_sparse if (ingest_combine and sparse) else None
+        ),
         name="bipartiteness-check",
     )
 

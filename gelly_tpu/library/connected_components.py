@@ -27,7 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.stream import EdgeStream
-from ..engine.aggregation import SummaryAggregation
+from ..engine.aggregation import (  # noqa: F401  (threshold re-exported)
+    SPARSE_CODEC_MIN_CAPACITY,
+    SummaryAggregation,
+)
 from ..ops import segments, unionfind
 
 
@@ -70,6 +73,36 @@ def cc_labels_numpy(src: np.ndarray, dst: np.ndarray,
     return lab
 
 
+def cc_pairs_numpy(src: np.ndarray, dst: np.ndarray,
+                   valid: np.ndarray | None, n_v: int):
+    """Pure-numpy fallback for the native sparse combiner: counted
+    (vertex, root) pairs of one chunk's spanning forest — work and payload
+    proportional to touched vertices, never ``n_v``."""
+    if valid is not None:
+        m = np.asarray(valid, bool)
+        src, dst = np.asarray(src)[m], np.asarray(dst)[m]
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    if src.size == 0:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    ids = np.unique(np.concatenate([src, dst]))
+    if ids[0] < 0 or ids[-1] >= n_v:
+        raise ValueError("cc_pairs_numpy: vertex slot out of range")
+    ls = np.searchsorted(ids, src)
+    ld = np.searchsorted(ids, dst)
+    lab = np.arange(ids.shape[0], dtype=np.int64)
+    while True:
+        prev = lab
+        mn = np.minimum(lab[ls], lab[ld])
+        lab = lab.copy()
+        np.minimum.at(lab, ls, mn)
+        np.minimum.at(lab, ld, mn)
+        lab = np.minimum(lab, lab[lab])
+        if np.array_equal(lab, prev):
+            break
+    return ids.astype(np.int32), ids[lab].astype(np.int32)
+
+
 def merge_chunk_forest(glob: np.ndarray, lab: np.ndarray) -> np.ndarray:
     """Hook a chunk's spanning-forest labels into a global dense forest
     (host numpy — the vectorized CPU analog of the device union).
@@ -97,7 +130,8 @@ def merge_chunk_forest(glob: np.ndarray, lab: np.ndarray) -> np.ndarray:
 
 
 def connected_components(
-    vertex_capacity: int, merge: str = "tree", ingest_combine: bool = True
+    vertex_capacity: int, merge: str = "tree", ingest_combine: bool = True,
+    codec: str = "auto",
 ) -> SummaryAggregation:
     """Build the CC aggregation over a slot space of ``vertex_capacity``.
 
@@ -107,11 +141,29 @@ def connected_components(
     ``ingest_combine`` (default on) attaches the ingest codec: each chunk is
     pre-reduced on the host to its spanning forest (the reference's
     per-partition partial fold, M/SummaryBulkAggregation.java:76-80, moved
-    to the ingest side) and shipped as a dense i32 label array — 1-2 orders
-    of magnitude fewer H2D bytes per edge. The device then unions the
-    (vertex, root) star edges, preserving connectivity exactly.
+    to the ingest side). The device then unions the (vertex, root) star
+    edges, preserving connectivity exactly — 1-2 orders of magnitude fewer
+    H2D bytes per edge.
+
+    ``codec`` picks the payload wire format:
+
+    - ``"dense"`` — i32[n_v] label array per chunk. Optimal when the slot
+      space is small relative to chunk size (payload is a fixed n_v*4
+      bytes and the device fold is a fixed-shape star union).
+    - ``"sparse"`` — counted (vertex, root) pairs, bucket-padded per batch
+      (:func:`~gelly_tpu.engine.aggregation.bucket_stack_payloads`).
+      Payload ∝ touched vertices — required at Twitter-class n_v, where a
+      dense payload (e.g. 64 MB at n_v = 2^24) would invert the codec's
+      compression. Host combine cost is O(chunk), not O(n_v), matching
+      the reference's touched-keys-proportional partial fold
+      (M/SummaryBulkAggregation.java:109-130).
+    - ``"auto"`` (default) — sparse iff ``vertex_capacity >=``
+      :data:`SPARSE_CODEC_MIN_CAPACITY` (2^20).
     """
+    from ..engine.aggregation import resolve_sparse_codec
+
     n = vertex_capacity
+    sparse = resolve_sparse_codec(codec, n)
 
     def init() -> CCSummary:
         return CCSummary(
@@ -151,6 +203,35 @@ def connected_components(
         )
         return CCSummary(parent, s.seen | present)
 
+    def host_compress_sparse(chunk) -> dict:
+        from ..utils import native
+
+        if native.sparse_codecs_available():
+            v, r = native.cc_chunk_combine_sparse(
+                np.asarray(chunk.src), np.asarray(chunk.dst),
+                np.asarray(chunk.valid), n,
+            )
+        else:
+            v, r = cc_pairs_numpy(chunk.src, chunk.dst, chunk.valid, n)
+        return {"v": v, "r": r}
+
+    def stack_sparse(payloads: list) -> dict:
+        from ..engine.aggregation import bucket_stack_payloads
+
+        return bucket_stack_payloads(payloads, {"v": -1, "r": 0})
+
+    def fold_compressed_sparse(s: CCSummary, payload) -> CCSummary:
+        # payload: {"v": i32[K, cap], "r": i32[K, cap]} — K chunks' counted
+        # (vertex, root) pairs, -1-padded. The pairs are union edges; one
+        # joint fixpoint unions all K chunks at once.
+        v = payload["v"].reshape(-1)
+        r = payload["r"].reshape(-1)
+        ok = v >= 0
+        vi = jnp.where(ok, v, 0)
+        parent = unionfind.union_edges(s.parent, vi, r, ok)
+        seen = segments.mark_seen(s.seen, vi, ok)
+        return CCSummary(parent, seen)
+
     def combine(a: CCSummary, b: CCSummary) -> CCSummary:
         return CCSummary(
             parent=unionfind.merge_forests(a.parent, b.parent),
@@ -173,8 +254,17 @@ def connected_components(
         transform=transform,
         merge_stacked=merge_stacked if merge == "gather" else None,
         transient=False,
-        host_compress=host_compress if ingest_combine else None,
-        fold_compressed=fold_compressed if ingest_combine else None,
+        host_compress=(
+            (host_compress_sparse if sparse else host_compress)
+            if ingest_combine else None
+        ),
+        fold_compressed=(
+            (fold_compressed_sparse if sparse else fold_compressed)
+            if ingest_combine else None
+        ),
+        stack_payloads=(
+            stack_sparse if (ingest_combine and sparse) else None
+        ),
         name=f"connected-components-{merge}",
     )
 

@@ -28,20 +28,30 @@ from ..parallel.mesh import SHARD_AXIS
 
 
 def degree_aggregate(vertex_capacity: int, count_out: bool = True,
-                     count_in: bool = True, ingest_combine: bool = True):
+                     count_in: bool = True, ingest_combine: bool = True,
+                     codec: str = "auto"):
     """Continuous degree aggregate as a SummaryAggregation — the engine
     form of ``getDegrees`` (SimpleEdgeStream.java:413-478, BASELINE
     workload #1): summary = dense degree vector, fold = ±1 endpoint
     scatter, combine = elementwise add.
 
     ``ingest_combine`` attaches the degree codec: each chunk pre-reduces on
-    the host to a dense i32 delta vector (two ``np.bincount`` calls —
-    deletions subtract), shipping N*4 bytes instead of the chunk's edges;
-    the device fold is a vector add. Same H2D rationale as the CC codec.
+    the host to its net degree deltas, shipping those instead of the
+    chunk's edges; the device fold is a vector add / scatter-add. Same H2D
+    rationale as the CC codec.
+
+    ``codec``: ``"dense"`` (i32[n_v] delta vector per chunk — optimal at
+    small n_v) / ``"sparse"`` (counted (vertex, net-delta) pairs — payload
+    and host work ∝ touched vertices, the large-n_v format) / ``"auto"``
+    (sparse iff ``vertex_capacity >= SPARSE_CODEC_MIN_CAPACITY``).
     """
-    from ..engine.aggregation import SummaryAggregation
+    from ..engine.aggregation import (
+        SummaryAggregation,
+        resolve_sparse_codec,
+    )
 
     n = vertex_capacity
+    sparse = resolve_sparse_codec(codec, n)
 
     def init():
         return jnp.zeros((n,), jnp.int64)
@@ -94,15 +104,89 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
     def fold_compressed(deg, deltas):  # deltas: i32[K, n]
         return deg + jnp.sum(deltas, axis=0, dtype=jnp.int64)
 
+    def host_compress_sparse(chunk) -> dict:
+        m = np.asarray(chunk.valid)
+        ev = np.asarray(chunk.event)
+        from ..utils import native
+
+        if native.sparse_codecs_available():
+            v, d = native.degree_chunk_deltas_sparse(
+                np.asarray(chunk.src), np.asarray(chunk.dst),
+                ev if ev.any() else None, None if m.all() else m,
+                n, count_out, count_in,
+            )
+        else:
+            v, d = degree_pairs_numpy(
+                chunk.src, chunk.dst, ev, m, n, count_out, count_in
+            )
+        return {"v": v, "d": d}
+
+    def stack_sparse(payloads: list) -> dict:
+        from ..engine.aggregation import bucket_stack_payloads
+
+        return bucket_stack_payloads(payloads, {"v": -1, "d": 0})
+
+    def fold_compressed_sparse(deg, payload):
+        # payload: {"v": i32[K, cap], "d": i32[K, cap]} counted (vertex,
+        # net-delta) pairs, -1-padded.
+        v = payload["v"].reshape(-1)
+        ok = v >= 0
+        return segments.masked_scatter_add(
+            deg, jnp.where(ok, v, 0), payload["d"].reshape(-1), ok
+        )
+
     return SummaryAggregation(
         init=init,
         fold=fold,
         combine=lambda a, b: a + b,
         transform=None,
-        host_compress=host_compress if ingest_combine else None,
-        fold_compressed=fold_compressed if ingest_combine else None,
+        host_compress=(
+            (host_compress_sparse if sparse else host_compress)
+            if ingest_combine else None
+        ),
+        fold_compressed=(
+            (fold_compressed_sparse if sparse else fold_compressed)
+            if ingest_combine else None
+        ),
+        stack_payloads=(
+            stack_sparse if (ingest_combine and sparse) else None
+        ),
         name="degree-aggregate",
     )
+
+
+def degree_pairs_numpy(src, dst, event, valid, n_v: int,
+                       count_out: bool = True, count_in: bool = True):
+    """Pure-numpy fallback for the native sparse degree codec: counted
+    (vertex, net-delta) pairs (zero net deltas omitted)."""
+    m = None if valid is None else np.asarray(valid, bool)
+    ev = None if event is None else np.asarray(event)
+    ids_parts, delta_parts = [], []
+    for on, col in ((count_out, src), (count_in, dst)):
+        if not on:
+            continue
+        col = np.asarray(col)
+        d = (
+            np.ones(col.shape[0], np.int64) if ev is None or not ev.any()
+            else np.where(ev == 1, -1, 1).astype(np.int64)
+        )
+        if m is not None and not m.all():
+            col, d = col[m], d[m]
+        ids_parts.append(col)
+        delta_parts.append(d)
+    if not ids_parts:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    ids = np.concatenate(ids_parts)
+    deltas = np.concatenate(delta_parts)
+    if ids.size == 0:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    if ids.min() < 0 or ids.max() >= n_v:
+        raise ValueError("degree_pairs_numpy: vertex slot out of range")
+    uniq, inv = np.unique(ids, return_inverse=True)
+    acc = np.zeros(uniq.shape[0], np.int64)
+    np.add.at(acc, inv, deltas)
+    nz = acc != 0
+    return uniq[nz].astype(np.int32), acc[nz].astype(np.int32)
 
 
 def degree_distribution(stream, max_degree: int | None = None

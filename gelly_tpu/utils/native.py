@@ -107,8 +107,35 @@ def _load_combiner() -> ctypes.CDLL:
             lib._has_degree_deltas = True
         except AttributeError:
             lib._has_degree_deltas = False
+        # Sparse (touched-slot) codec variants — same separate-binding
+        # rationale.
+        try:
+            lib.cc_chunk_combine_sparse.restype = ctypes.c_int64
+            lib.cc_chunk_combine_sparse.argtypes = [
+                _i32p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int32,
+                _i32p, _i32p, ctypes.c_int64,
+            ]
+            lib.parity_chunk_combine_sparse.restype = ctypes.c_int64
+            lib.parity_chunk_combine_sparse.argtypes = [
+                _i32p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int32,
+                _i32p, _i32p, _u8p, _i32p, ctypes.c_int64,
+            ]
+            lib.degree_chunk_deltas_sparse.restype = ctypes.c_int64
+            lib.degree_chunk_deltas_sparse.argtypes = [
+                _i32p, _i32p, ctypes.POINTER(ctypes.c_int8), _u8p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, _i32p, _i32p, ctypes.c_int64,
+            ]
+            lib._has_sparse_codecs = True
+        except AttributeError:
+            lib._has_sparse_codecs = False
         lib._sigs_set = True
     return lib
+
+
+def sparse_codecs_available() -> bool:
+    """The chunk-combiner library loads AND exports the sparse codecs."""
+    return available("chunk_combiner") and _load_combiner()._has_sparse_codecs
 
 
 def degree_deltas_available() -> bool:
@@ -341,6 +368,93 @@ def degree_chunk_deltas(src: np.ndarray, dst: np.ndarray,
             f"degree_chunk_deltas: vertex slot out of range (rc={rc})"
         )
     return out
+
+
+def _sparse_rc_check(rc: int, fn: str) -> None:
+    if rc == -2:
+        raise ValueError(f"{fn}: vertex slot out of range")
+    if rc == -3:
+        raise ValueError(f"{fn}: pair capacity overflow")
+    if rc < 0:
+        raise MemoryError(f"{fn}: allocation failed (rc={rc})")
+
+
+def cc_chunk_combine_sparse(src: np.ndarray, dst: np.ndarray,
+                            valid: np.ndarray | None, n_v: int):
+    """Counted (vertex, root) pairs of one chunk's spanning forest —
+    the touched-slot codec (payload ∝ touched vertices, never n_v).
+    Returns ``(verts i32[t], roots i32[t])``. GIL released during the call.
+    """
+    lib = _load_combiner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    cap = 2 * max(1, src.shape[0])
+    out_v = np.empty((cap,), np.int32)
+    out_r = np.empty((cap,), np.int32)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    rc = lib.cc_chunk_combine_sparse(
+        _as_i32p(src), _as_i32p(dst), vp, src.shape[0], n_v,
+        _as_i32p(out_v), _as_i32p(out_r), cap,
+    )
+    _sparse_rc_check(rc, "cc_chunk_combine_sparse")
+    return out_v[:rc], out_r[:rc]
+
+
+def parity_chunk_combine_sparse(src: np.ndarray, dst: np.ndarray,
+                                valid: np.ndarray | None, n_v: int):
+    """Counted (vertex, root, parity) triples + chunk odd-cycle flag.
+    Returns ``(verts i32[t], roots i32[t], parity u8[t], conflict bool)``."""
+    lib = _load_combiner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    cap = 2 * max(1, src.shape[0])
+    out_v = np.empty((cap,), np.int32)
+    out_r = np.empty((cap,), np.int32)
+    out_p = np.empty((cap,), np.uint8)
+    conflict = ctypes.c_int32(0)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    rc = lib.parity_chunk_combine_sparse(
+        _as_i32p(src), _as_i32p(dst), vp, src.shape[0], n_v,
+        _as_i32p(out_v), _as_i32p(out_r), out_p.ctypes.data_as(_u8p),
+        ctypes.byref(conflict), cap,
+    )
+    _sparse_rc_check(rc, "parity_chunk_combine_sparse")
+    return out_v[:rc], out_r[:rc], out_p[:rc], bool(conflict.value)
+
+
+def degree_chunk_deltas_sparse(src: np.ndarray, dst: np.ndarray,
+                               event: np.ndarray | None,
+                               valid: np.ndarray | None, n_v: int,
+                               count_out: bool = True,
+                               count_in: bool = True):
+    """Counted (vertex, net-delta) pairs of one chunk (zero net deltas
+    omitted). Returns ``(verts i32[t], deltas i32[t])``."""
+    lib = _load_combiner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    cap = 2 * max(1, src.shape[0])
+    out_v = np.empty((cap,), np.int32)
+    out_d = np.empty((cap,), np.int32)
+    ep = None
+    if event is not None:
+        event = np.ascontiguousarray(event, np.int8)
+        ep = event.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    rc = lib.degree_chunk_deltas_sparse(
+        _as_i32p(src), _as_i32p(dst), ep, vp, src.shape[0], n_v,
+        int(count_out), int(count_in), _as_i32p(out_v), _as_i32p(out_d), cap,
+    )
+    _sparse_rc_check(rc, "degree_chunk_deltas_sparse")
+    return out_v[:rc], out_d[:rc]
 
 
 def parse_edge_list_file(path: str, want_vals: bool = False):

@@ -1,0 +1,319 @@
+"""Sparse (touched-slot) ingest codecs — the large-n_v wire format.
+
+VERDICT r2 item 2: dense i32[n_v] payloads invert the codec's compression
+at Twitter-class n_v (256 MB per chunk at n_v ~ 2^26). The sparse codecs
+emit counted (vertex, value) pairs — payload and host combine work
+proportional to the chunk's *touched* vertices, mirroring the reference's
+per-subtask HashMap partial fold (SummaryBulkAggregation.java:109-130).
+These tests assert pair/dense equivalence at the native layer, numpy
+fallback parity, end-to-end component/degree parity on single shard and
+the 8-virtual-device mesh, and that wire bytes track touched counts.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_tpu.core.io import EdgeChunkSource
+from gelly_tpu.core.stream import edge_stream_from_source
+from gelly_tpu.core.vertices import IdentityVertexTable
+from gelly_tpu.engine.aggregation import bucket_stack_payloads
+from gelly_tpu.library.connected_components import (
+    cc_labels_numpy,
+    cc_pairs_numpy,
+    connected_components,
+    labels_to_components,
+)
+from gelly_tpu.parallel import mesh as mesh_lib
+from gelly_tpu.utils import native
+
+N_V = 64
+
+
+def _rand_edges(n_e=500, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, N_V, n_e).astype(np.int64),
+            rng.integers(0, N_V, n_e).astype(np.int64))
+
+
+def _stream(src, dst, chunk_size=64, n_v=N_V, events=None):
+    return edge_stream_from_source(
+        EdgeChunkSource(src, dst, events=events, chunk_size=chunk_size,
+                        table=IdentityVertexTable(n_v)),
+        n_v,
+    )
+
+
+def _host_components(src, dst):
+    parent = {}
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for u, v in zip(src.tolist(), dst.tolist()):
+        parent.setdefault(u, u)
+        parent.setdefault(v, v)
+        ru, rv = find(u), find(v)
+        if ru != rv:
+            parent[max(ru, rv)] = min(ru, rv)
+    comps = {}
+    for x in parent:
+        comps.setdefault(find(x), set()).add(x)
+    return sorted(sorted(c) for c in comps.values())
+
+
+def _pairs_to_labels(verts, roots, n_v):
+    lab = np.full(n_v, -1, np.int32)
+    lab[verts] = roots
+    return lab
+
+
+# ------------------------- native layer parity ------------------------- #
+
+
+def _need_native():
+    if not native.sparse_codecs_available():
+        pytest.skip("native sparse codecs unavailable")
+
+
+def test_cc_sparse_native_matches_dense():
+    _need_native()
+    src, dst = _rand_edges(n_e=2000, seed=3)
+    valid = np.ones(src.shape[0], bool)
+    valid[::7] = False
+    dense = native.cc_chunk_combine(
+        src.astype(np.int32), dst.astype(np.int32), valid, N_V
+    )
+    v, r = native.cc_chunk_combine_sparse(
+        src.astype(np.int32), dst.astype(np.int32), valid, N_V
+    )
+    # Exactly the touched slots, each with its canonical min-root.
+    np.testing.assert_array_equal(
+        np.sort(v), np.nonzero(dense >= 0)[0].astype(np.int32)
+    )
+    np.testing.assert_array_equal(_pairs_to_labels(v, r, N_V), dense)
+
+
+def test_cc_sparse_numpy_fallback_matches_native():
+    _need_native()
+    src, dst = _rand_edges(n_e=1500, seed=4)
+    v_n, r_n = cc_pairs_numpy(src, dst, None, N_V)
+    v_c, r_c = native.cc_chunk_combine_sparse(
+        src.astype(np.int32), dst.astype(np.int32), None, N_V
+    )
+    np.testing.assert_array_equal(
+        _pairs_to_labels(v_n, r_n, N_V), _pairs_to_labels(v_c, r_c, N_V)
+    )
+
+
+def test_cc_sparse_empty_chunk():
+    _need_native()
+    v, r = native.cc_chunk_combine_sparse(
+        np.empty(0, np.int32), np.empty(0, np.int32), None, N_V
+    )
+    assert v.shape == (0,) and r.shape == (0,)
+    assert cc_pairs_numpy(np.empty(0, np.int64), np.empty(0, np.int64),
+                          None, N_V)[0].shape == (0,)
+
+
+def test_cc_sparse_rejects_bad_slot():
+    _need_native()
+    with pytest.raises(ValueError):
+        native.cc_chunk_combine_sparse(
+            np.array([N_V], np.int32), np.array([0], np.int32), None, N_V
+        )
+    with pytest.raises(ValueError):
+        cc_pairs_numpy(np.array([N_V]), np.array([0]), None, N_V)
+
+
+def test_parity_sparse_native_matches_dense():
+    _need_native()
+    from gelly_tpu.library.bipartiteness import parity_pairs_numpy
+
+    rng = np.random.default_rng(5)
+    left = rng.integers(0, N_V // 2, 400).astype(np.int32)
+    right = (rng.integers(0, N_V // 2, 400) + N_V // 2).astype(np.int32)
+    lab_d, par_d, conf_d = native.parity_chunk_combine(
+        left, right, None, N_V
+    )
+    v, r, p, conf_s = native.parity_chunk_combine_sparse(
+        left, right, None, N_V
+    )
+    assert conf_s == bool(conf_d)
+    np.testing.assert_array_equal(_pairs_to_labels(v, r, N_V), lab_d)
+    touched = lab_d >= 0
+    got_p = np.zeros(N_V, np.uint8)
+    got_p[v] = p
+    np.testing.assert_array_equal(got_p[touched], par_d[touched])
+    # numpy fallback agrees too
+    v_n, r_n, p_n, conf_n = parity_pairs_numpy(left, right, None, N_V)
+    assert conf_n == conf_s
+    np.testing.assert_array_equal(
+        _pairs_to_labels(v_n, r_n, N_V), lab_d
+    )
+    got_pn = np.zeros(N_V, np.uint8)
+    got_pn[v_n] = p_n
+    np.testing.assert_array_equal(got_pn[touched], par_d[touched])
+    # Odd cycle flags conflict on the sparse paths.
+    tri = np.array([0, 1, 2], np.int32), np.array([1, 2, 0], np.int32)
+    assert native.parity_chunk_combine_sparse(*tri, None, N_V)[3]
+    assert parity_pairs_numpy(*tri, None, N_V)[3]
+
+
+@pytest.mark.parametrize("with_deletions", [False, True])
+def test_degree_sparse_native_matches_dense(with_deletions):
+    _need_native()
+    from gelly_tpu.library.degrees import degree_pairs_numpy
+
+    rng = np.random.default_rng(6)
+    n_e = 800
+    src = rng.integers(0, N_V, n_e).astype(np.int32)
+    dst = rng.integers(0, N_V, n_e).astype(np.int32)
+    ev = np.zeros(n_e, np.int8)
+    if with_deletions:
+        ev[rng.random(n_e) < 0.3] = 1
+    dense = native.degree_chunk_deltas(src, dst, ev, None, N_V, True, True)
+    v, d = native.degree_chunk_deltas_sparse(
+        src, dst, ev, None, N_V, True, True
+    )
+    got = np.zeros(N_V, np.int32)
+    got[v] = d
+    np.testing.assert_array_equal(got, dense)
+    assert (d != 0).all()  # zero net deltas omitted
+    v_n, d_n = degree_pairs_numpy(src, dst, ev, None, N_V, True, True)
+    got_n = np.zeros(N_V, np.int32)
+    got_n[v_n] = d_n
+    np.testing.assert_array_equal(got_n, dense)
+
+
+# ----------------------------- end to end ----------------------------- #
+
+
+def test_cc_sparse_codec_end_to_end():
+    src, dst = _rand_edges()
+    oracle = _host_components(src, dst)
+    for mesh, me, fb in [(mesh_lib.make_mesh(1), 2, 1),
+                         (mesh_lib.make_mesh(1), 4, 4),
+                         (mesh_lib.make_mesh(8), 8, 8)]:
+        agg = connected_components(N_V, merge="gather", codec="sparse")
+        s = _stream(src, dst)
+        labels = s.aggregate(agg, mesh=mesh, merge_every=me,
+                             fold_batch=fb).result()
+        assert labels_to_components(labels, s.ctx) == oracle, (me, fb)
+
+
+def test_cc_sparse_matches_dense_codec():
+    src, dst = _rand_edges(n_e=500, seed=2)
+    mesh = mesh_lib.make_mesh(1)
+    out = {}
+    for codec in ("dense", "sparse"):
+        agg = connected_components(N_V, merge="gather", codec=codec)
+        s = _stream(src, dst)
+        out[codec] = np.asarray(
+            s.aggregate(agg, mesh=mesh, merge_every=4, fold_batch=4).result()
+        )
+    np.testing.assert_array_equal(out["dense"], out["sparse"])
+
+
+def test_bipartiteness_sparse_codec_end_to_end():
+    from gelly_tpu.library.bipartiteness import bipartiteness_check
+
+    rng = np.random.default_rng(9)
+    left = rng.integers(0, N_V // 2, 256).astype(np.int64)
+    right = (rng.integers(0, N_V // 2, 256) + N_V // 2).astype(np.int64)
+    for mesh, me, fb in [(mesh_lib.make_mesh(1), 4, 4),
+                         (mesh_lib.make_mesh(8), 8, 8)]:
+        agg = bipartiteness_check(N_V, codec="sparse")
+        s = _stream(left, right, chunk_size=32)
+        res = s.aggregate(agg, mesh=mesh, merge_every=me,
+                          fold_batch=fb).result()
+        assert bool(res.ok)
+        col = np.asarray(res.colors)
+        assert (col[left] ^ col[right]).all()
+    # Odd cycle flips ok.
+    src = np.concatenate([left, [1, 2, 3]])
+    dst = np.concatenate([right, [2, 3, 1]])
+    agg = bipartiteness_check(N_V, codec="sparse")
+    s = _stream(src, dst, chunk_size=32)
+    res = s.aggregate(agg, mesh=mesh_lib.make_mesh(1), merge_every=4,
+                      fold_batch=4).result()
+    assert not bool(res.ok)
+
+
+@pytest.mark.parametrize("with_deletions", [False, True])
+def test_degree_sparse_codec_end_to_end(with_deletions):
+    from gelly_tpu.library.degrees import degree_aggregate
+
+    rng = np.random.default_rng(5)
+    n_e = 300
+    src = rng.integers(0, N_V, n_e).astype(np.int64)
+    dst = rng.integers(0, N_V, n_e).astype(np.int64)
+    ev = np.zeros(n_e, np.int32)
+    if with_deletions:
+        ev[rng.random(n_e) < 0.2] = 1
+    oracle = np.zeros(N_V, np.int64)
+    sign = np.where(ev == 1, -1, 1)
+    np.add.at(oracle, src, sign)
+    np.add.at(oracle, dst, sign)
+    for fb in (1, 4):
+        agg = degree_aggregate(N_V, codec="sparse")
+        got = np.asarray(
+            _stream(src, dst, events=ev).aggregate(
+                agg, merge_every=4, fold_batch=fb
+            ).result()
+        )
+        assert (got == oracle).all(), fb
+
+
+# ------------------------- wire format details ------------------------- #
+
+
+def test_bucket_stack_payloads():
+    payloads = [
+        {"v": np.array([1, 2, 3], np.int32), "r": np.array([1, 1, 1], np.int32),
+         "flag": np.bool_(True)},
+        {"v": np.empty(0, np.int32), "r": np.empty(0, np.int32),
+         "flag": np.bool_(False)},
+    ]
+    out = bucket_stack_payloads(payloads, {"v": -1, "r": 0}, min_bucket=4)
+    assert out["v"].shape == (2, 4)
+    np.testing.assert_array_equal(out["v"][0], [1, 2, 3, -1])
+    np.testing.assert_array_equal(out["v"][1], [-1, -1, -1, -1])
+    np.testing.assert_array_equal(out["r"][0], [1, 1, 1, 0])
+    np.testing.assert_array_equal(out["flag"], [True, False])
+    # Bucket rounds up to the next power of two past min_bucket.
+    big = [{"v": np.zeros(37, np.int32), "r": np.zeros(37, np.int32)}]
+    assert bucket_stack_payloads(big, {"v": -1, "r": 0},
+                                 min_bucket=4)["v"].shape == (1, 64)
+
+
+def test_payload_bytes_track_touched_not_capacity():
+    # The sparse payload for a chunk touching t vertices over a 2^24 slot
+    # space is ~2 * next_pow2(t) * 4 bytes — nowhere near n_v * 4.
+    n_v = 1 << 24
+    agg = connected_components(n_v, merge="gather")  # auto -> sparse
+    assert agg.stack_payloads is not None
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, n_v, 4096).astype(np.int64)
+    dst = rng.integers(0, n_v, 4096).astype(np.int64)
+    from gelly_tpu.core.chunk import make_chunk
+
+    chunk = make_chunk(src, dst, device=False)
+    payload = agg.host_compress(chunk)
+    stacked = agg.stack_payloads([payload])
+    nbytes = sum(a.nbytes for a in stacked.values())
+    assert nbytes <= 2 * 4 * (1 << 13)  # 2 arrays * 4B * bucket(8192)
+    assert nbytes < n_v  # << dense payload (n_v * 4 bytes)
+
+
+def test_auto_codec_threshold():
+    from gelly_tpu.library.connected_components import (
+        SPARSE_CODEC_MIN_CAPACITY,
+    )
+
+    small = connected_components(N_V)
+    big = connected_components(SPARSE_CODEC_MIN_CAPACITY)
+    assert small.stack_payloads is None  # dense
+    assert big.stack_payloads is not None  # sparse
