@@ -290,7 +290,34 @@ def _compiled_plan(agg: SummaryAggregation, m):
             # All shards hold the identical global merge; take shard 0.
             return unshard_leaf(merged)
 
-        fold_many = None  # chunk batching is the S=1 dispatch-amortizer
+        @partial(jax.jit, out_shardings=sharded)
+        def fold_many(locals_, stacked_chunk):
+            # K chunks in one dispatch on the sharded raw path (VERDICT r2
+            # item 7): each chunk of the host-stacked [K, C] batch splits
+            # across shards ([S, K, C/S]) and the per-shard fold scans the
+            # batch inside a single shard_map program — the same K-fold
+            # dispatch amortization as the S=1 fold_many. The split itself
+            # is fold_step's split_chunk, vmapped over the batch axis.
+            split = jax.vmap(
+                lambda c: partition.split_chunk(c, S)
+            )(stacked_chunk)
+            chunk_split = EdgeChunk(*(x.swapaxes(0, 1) for x in split))
+
+            def body(loc, ckb):
+                s = unshard_leaf(loc)
+
+                def step(acc, ck):
+                    return agg.fold(acc, ck), None
+
+                s, _ = jax.lax.scan(
+                    step, s, EdgeChunk(*(x[0] for x in ckb))
+                )
+                return shard_leaf(s)
+
+            return mesh_lib.shard_map_fn(
+                m, body, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                out_specs=P(SHARD_AXIS),
+            )(locals_, chunk_split)
 
         if agg.fold_compressed is not None:
             # Codec payloads are data-parallel over the chunk axis: a batch
@@ -432,8 +459,6 @@ def run_aggregation(
                 batch = S if merge_every % S == 0 else 1
             if batch % S:
                 use_codec = False  # no aligned batching possible
-        if batch > 1 and not use_codec and fold_many is None:
-            batch = 1  # raw-chunk batching is the S=1 dispatch amortizer
 
     stats = {"late_edges": 0, "windows_closed": 0, "chunks": 0}
 

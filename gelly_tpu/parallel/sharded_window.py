@@ -233,13 +233,8 @@ class ShardedSnapshotStream:
                 _, scanned = jax.lax.associative_scan(
                     comb, (v.starts, v.val)
                 )
-                nxt = jnp.concatenate([v.starts[1:], jnp.ones((1,), bool)])
-                nxt_invalid = jnp.concatenate(
-                    [~v.valid[1:], jnp.ones((1,), bool)]
-                )
-                ends = v.valid & (nxt | nxt_invalid)
                 return jax.tree.map(
-                    lambda x: x[None], (v.key, scanned, ends)
+                    lambda x: x[None], (v.key, scanned, v.ends())
                 )
 
             return mesh_lib.shard_map_fn(
@@ -255,6 +250,79 @@ class ShardedSnapshotStream:
                 jnp.reshape(vals, (-1,)),
                 jnp.reshape(ends, (-1,)),
             )
+
+    def fold_neighbors(self, initial_value,
+                       fold_fn: Callable) -> Iterator[WindowUpdate]:
+        """Mesh form of ``SnapshotStream.foldNeighbors``
+        (M/SnapshotStream.java:61-86): exact per-edge fold-order parity via
+        a segmented ``lax.scan`` per device over its co-located vertex runs
+        (the keyed exchange guarantees a vertex's whole window neighborhood
+        sits on one device, so per-vertex fold order is globally correct).
+        Yields WindowUpdates with [S*C]-flattened arrays."""
+        init = jax.tree.map(jnp.asarray, initial_value)
+
+        @jax.jit
+        def close(view):
+            def body(v):
+                v = jax.tree.map(lambda x: x[0], v)
+
+                def step(acc, inp):
+                    key, nbr, val, ok, start = inp
+                    acc = jax.tree.map(
+                        lambda i, a: jnp.where(start, i, a), init, acc
+                    )
+                    new = fold_fn(acc, key, nbr, val)
+                    acc = jax.tree.map(
+                        lambda n_, o: jnp.where(ok, n_, o), new, acc
+                    )
+                    return acc, acc
+
+                _, accs = jax.lax.scan(
+                    step, init,
+                    (v.key, v.nbr, v.val, v.valid, v.starts),
+                )
+                return jax.tree.map(
+                    lambda x: x[None], (v.key, accs, v.ends())
+                )
+
+            return mesh_lib.shard_map_fn(
+                self.mesh, body, in_specs=(P(SHARD_AXIS),),
+                out_specs=P(SHARD_AXIS),
+            )(view)
+
+        for w, view in self._windows():
+            key, accs, ends = close(view)
+            yield WindowUpdate(
+                w,
+                jnp.reshape(key, (-1,)),
+                jax.tree.map(
+                    lambda x: jnp.reshape(x, (-1,) + x.shape[2:]), accs
+                ),
+                jnp.reshape(ends, (-1,)),
+            )
+
+    def apply_on_neighbors(self, apply_fn: Callable) -> Iterator[tuple]:
+        """Mesh form of ``SnapshotStream.applyOnNeighbors``
+        (M/SnapshotStream.java:129-181): ``apply_fn(view)`` runs jitted
+        per device on its local sorted :class:`NeighborhoodView` inside
+        ``shard_map`` — the UDF may use jax collectives (``psum`` etc.)
+        over the shard axis for cross-device aggregation. Yields
+        ``(window, [S, ...]-stacked outputs)``."""
+
+        @jax.jit
+        def close(view):
+            def body(v):
+                v = jax.tree.map(lambda x: x[0], v)
+                out = apply_fn(v)
+                return jax.tree.map(lambda x: jnp.asarray(x)[None], out)
+
+            return mesh_lib.shard_map_fn(
+                self.mesh, body, in_specs=(P(SHARD_AXIS),),
+                out_specs=P(SHARD_AXIS),
+            )(view)
+
+        for w, view in self._windows():
+            yield w, close(view)
 
     def views(self) -> Iterator[tuple[int, NeighborhoodView]]:
         """Raw (window, [S, C]-sharded sorted views) — escape hatch."""

@@ -296,3 +296,19 @@ def test_degree_codec_parity(with_deletions, count_out, count_in):
             agg, merge_every=4, fold_batch=fold_batch
         ).result())
         assert (got == oracle).all(), (ingest_combine, fold_batch)
+
+
+def test_plain_batched_fold_mesh_parity():
+    # VERDICT r2 item 7: fold_many on the sharded raw path — K chunks per
+    # device dispatch, ~K x fewer fold dispatches, identical labels.
+    from gelly_tpu.utils.metrics import StageTimer
+
+    mesh = mesh_lib.make_mesh(8)
+    src, dst = _rand_edges()  # 500 edges, chunk 64 -> 8 chunks
+    agg = connected_components(N_V, merge="gather", ingest_combine=False)
+    s = _stream(src, dst)
+    timer = StageTimer()
+    labels = s.aggregate(agg, mesh=mesh, merge_every=4, fold_batch=4,
+                         timer=timer).result()
+    assert labels_to_components(labels, s.ctx) == _host_components(src, dst)
+    assert timer.counts["fold_dispatch"] == 2  # 8 chunks / batch of 4

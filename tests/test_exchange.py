@@ -182,3 +182,95 @@ def test_sharded_window_overflow_raises(mesh):
     with pytest.raises(ValueError, match="overflow|bucket"):
         for _ in sh.reduce_on_edges(jnp.minimum):
             pass
+
+
+def test_sharded_window_fold_matches_single_device(mesh):
+    # fold_neighbors on the mesh: exact per-edge fold-order parity with the
+    # single-device segmented scan (VERDICT r2 item 5).
+    rng = np.random.default_rng(5)
+    n = 400
+    src = rng.integers(0, N_V, n).astype(np.int64)
+    dst = rng.integers(0, N_V, n).astype(np.int64)
+    val = rng.integers(1, 10, n).astype(np.float64)
+    ts = np.sort(rng.integers(0, 4000, n)).astype(np.int64)
+
+    def fold_fn(acc, key, nbr, v):
+        return acc * 0.5 + v  # order-sensitive: exercises fold sequencing
+
+    def collect(updates):
+        out = {}
+        for upd in updates:
+            ok = np.asarray(upd.valid).astype(bool)
+            keys = np.asarray(upd.slots)[ok]
+            vals = np.asarray(upd.values)[ok]
+            out[upd.window] = dict(zip(keys.tolist(),
+                                       np.round(vals, 9).tolist()))
+        return out
+
+    for direction in ("out", "in", "all"):
+        sh = sharded_slice(
+            _stream(src, dst, ts=ts, val=val), 1000, direction,
+            window_capacity=2 * n, mesh=mesh,
+        ).fold_neighbors(0.0, fold_fn)
+        single = _stream(src, dst, ts=ts, val=val).slice(
+            1000, direction, window_capacity=2 * n
+        ).fold_neighbors(0.0, fold_fn)
+        assert collect(sh) == collect(single), direction
+
+
+def test_sharded_window_apply_matches_single_device(mesh):
+    # apply_on_neighbors on the mesh: per-device UDF over local views; the
+    # per-window edge-count sums across devices equal the single-device
+    # count.
+    rng = np.random.default_rng(6)
+    n = 300
+    src = rng.integers(0, N_V, n).astype(np.int64)
+    dst = rng.integers(0, N_V, n).astype(np.int64)
+    ts = np.sort(rng.integers(0, 3000, n)).astype(np.int64)
+
+    def udf(view):
+        return jnp.sum(view.valid.astype(jnp.int32))
+
+    sh = dict(
+        (w, int(np.asarray(out).sum()))
+        for w, out in sharded_slice(
+            _stream(src, dst, ts=ts), 1000, "out",
+            window_capacity=2 * n, mesh=mesh,
+        ).apply_on_neighbors(udf)
+    )
+    single = dict(
+        (w, int(out))
+        for w, out in _stream(src, dst, ts=ts).slice(
+            1000, "out", window_capacity=2 * n
+        ).apply_on_neighbors(udf)
+    )
+    assert sh == single
+
+
+def test_sharded_window_triangles_match_single_device(mesh):
+    from gelly_tpu.library.triangles import (
+        sharded_window_triangles,
+        window_triangles,
+    )
+
+    rng = np.random.default_rng(7)
+    n = 600
+    src = rng.integers(0, N_V, n).astype(np.int64)
+    dst = rng.integers(0, N_V, n).astype(np.int64)
+    # Duplicate a slice of edges so per-device dedup is exercised.
+    src[50:100] = src[:50]
+    dst[50:100] = dst[:50]
+    ts = np.sort(rng.integers(0, 4000, n)).astype(np.int64)
+
+    sharded = {
+        w: int(c) for w, c in sharded_window_triangles(
+            _stream(src, dst, ts=ts), 1000,
+            window_capacity=4 * n, mesh=mesh,
+        )
+    }
+    single = {
+        w: int(c) for w, c in window_triangles(
+            _stream(src, dst, ts=ts), 1000, window_capacity=4 * n,
+        )
+    }
+    assert sharded == single and sum(single.values()) > 0
