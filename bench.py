@@ -183,6 +183,8 @@ def baseline_cc_multicore(src: np.ndarray, dst: np.ndarray, n_v: int,
             with mp.get_context("fork").Pool(procs) as pool:
                 parts = pool.map_async(_mc_worker, ranges).get(timeout=600)
         except (OSError, mp.TimeoutError):
+            # Don't charge the failed/wedged pool to the baseline rate.
+            t0 = time.perf_counter()
             parts = [_mc_worker(r) for r in ranges]
     # Forest merge: the partial forests' (vertex, root) pairs are union
     # edges; one more pass merges them (CombineCC's reduce fan-in).

@@ -166,13 +166,15 @@ class SnapshotStream:
     """
 
     def __init__(self, stream, window_ms: int, direction: str = "out",
-                 window_capacity: int | None = None):
+                 window_capacity: int | None = None,
+                 allowed_lateness: int = 0):
         if direction not in ("out", "in", "all"):
             raise ValueError(f"direction must be out/in/all, got {direction}")
         self.stream = stream
         self.window_ms = int(window_ms)
         self.direction = direction
         self.window_capacity = window_capacity
+        self.allowed_lateness = int(allowed_lateness)
         self.stats = {"late_edges": 0, "windows_closed": 0}
 
     # -------------------------------------------------------------- #
@@ -201,7 +203,8 @@ class SnapshotStream:
         fill_host = 0
         cap = self.window_capacity
         for kind, w, chunk, n_valid in tumbling_window_events(
-            self._transformed(), self.window_ms, self.stats
+            self._transformed(), self.window_ms, self.stats,
+            allowed_lateness=self.allowed_lateness,
         ):
             if kind == "close":
                 c0 = parts[0]

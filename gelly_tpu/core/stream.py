@@ -377,12 +377,16 @@ class EdgeStream:
         return run_aggregation(aggregation, self, **runner_kw)
 
     def slice(self, window_ms: int, direction: str = "out",
-              window_capacity: int | None = None) -> "SnapshotStream":
+              window_capacity: int | None = None,
+              allowed_lateness: int = 0) -> "SnapshotStream":
         """Discretize into per-vertex tumbling-window neighborhoods
-        (M/SimpleEdgeStream.java:135-167). direction ∈ {out, in, all}."""
+        (M/SimpleEdgeStream.java:135-167). direction ∈ {out, in, all}.
+        ``allowed_lateness`` (ms) buffers out-of-order edges up to that
+        bound (core/windows.py watermark semantics)."""
         from .snapshot import SnapshotStream
 
-        return SnapshotStream(self, window_ms, direction, window_capacity)
+        return SnapshotStream(self, window_ms, direction, window_capacity,
+                              allowed_lateness)
 
     def build_neighborhood(self, directed: bool = False,
                            capacity: int | None = None,

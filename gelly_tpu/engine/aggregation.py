@@ -373,6 +373,7 @@ def run_aggregation(
     host_precombine: Callable | None = None,
     fold_batch: int = 1,
     ingest_workers: int = 2,
+    allowed_lateness: int = 0,
     timer=None,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
@@ -420,6 +421,15 @@ def run_aggregation(
     """
     if merge_every is not None and window_ms is not None:
         raise ValueError("pass at most one of merge_every / window_ms")
+    if allowed_lateness and checkpoint_path:
+        # Chunk-boundary checkpoints assume every consumed edge is already
+        # folded; the lateness reorder buffer holds consumed-but-unfolded
+        # edges, so a resume would silently drop them. Explicitly
+        # unsupported until checkpoints serialize the reorder buffer.
+        raise ValueError(
+            "allowed_lateness is not supported together with "
+            "checkpoint_path (buffered edges would be lost on resume)"
+        )
     if merge_every is None and window_ms is None:
         merge_every = 1
     if agg.merge_degree is not None:
@@ -669,6 +679,7 @@ def run_aggregation(
             for kind, w, chunk, _n in tumbling_window_events(
                 counted_chunks(), window_ms, stats,
                 initial_window=current_window,
+                allowed_lateness=allowed_lateness,
             ):
                 if kind == "close":
                     yield close_window()

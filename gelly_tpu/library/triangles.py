@@ -45,53 +45,87 @@ from ..ops import segments
 # windowed
 
 
+def _wedge_count_from_adj(adj: jax.Array, key: jax.Array, nbr: jax.Array,
+                          valid: jax.Array, n: int,
+                          method: str = "gather") -> jax.Array:
+    """Count triangles from a window adjacency + its (key, nbr) edge list.
+
+    Per unique canonical edge (a, b), counts wedge centers u adjacent to
+    both with u < a and u < b — the candidate/match semantics of
+    GenerateCandidateEdges + CountTriangles (WindowTriangles.java:82-139):
+    each triangle contributes exactly one candidate from its minimum
+    vertex. Shared by the single-device kernel (local adjacency) and the
+    mesh kernel (psum-assembled global adjacency).
+    """
+    # wedge mask: M[u, x] = edge(u, x) present with x > u
+    cols = jnp.arange(n, dtype=jnp.int32)
+    m = adj & (cols[None, :] > cols[:, None])
+    # unique canonical edges (a < b), one per undirected window edge
+    canon = valid & (key < nbr)
+    uniq = segments.unique_pairs_mask(key, nbr, canon, n)
+    if method.startswith("mxu"):
+        from ..ops.pallas_kernels import wedge_count_matrix
+
+        w = wedge_count_matrix(m, interpret=method == "mxu_interpret")
+        per_edge = w[key, nbr].astype(jnp.int32)
+    else:
+        # per-edge common smaller-neighbor count: dot of M columns a and b
+        per_edge = jnp.sum(m[:, key] & m[:, nbr], axis=0)
+    return jnp.sum(jnp.where(uniq, per_edge, 0))
+
+
 @partial(jax.jit, static_argnames=("capacity", "method"))
 def _window_triangle_count(view: NeighborhoodView, capacity: int,
                            method: str = "gather") -> jax.Array:
     """Triangles inside one window's (ALL-direction) sorted view.
 
-    Counts, per unique canonical window edge (a, b), the wedge centers u
-    adjacent to both with u < a and u < b — the candidate/match semantics of
-    GenerateCandidateEdges + CountTriangles (WindowTriangles.java:82-139):
-    each triangle contributes exactly one candidate from its minimum vertex.
-
     ``method="gather"`` walks per-edge column pairs on the VPU (O(N·E));
     ``method="mxu"``/``"mxu_interpret"`` computes the full wedge matrix
     W = MᵀM with the Pallas MXU kernel (O(N³) but at systolic-array rate —
-    the win for dense windows, E ≳ N).
+    the win for dense windows, E ≳ N). Counting semantics in
+    :func:`_wedge_count_from_adj`.
     """
     n = capacity
     key = jnp.where(view.valid, view.key, 0)
     nbr = jnp.where(view.valid, view.nbr, 0)
     adj = jnp.zeros((n, n), bool).at[key, nbr].max(view.valid, mode="drop")
-    # wedge mask: M[u, x] = edge(u, x) present with x > u
-    cols = jnp.arange(n, dtype=jnp.int32)
-    m = adj & (cols[None, :] > cols[:, None])
-    # unique canonical edges (a < b), one per undirected window edge
-    canon = view.valid & (view.key < view.nbr)
-    uniq = segments.unique_pairs_mask(view.key, view.nbr, canon, n)
-    if method.startswith("mxu"):
-        from ..ops.pallas_kernels import wedge_count_matrix
-
-        w = wedge_count_matrix(m, interpret=method == "mxu_interpret")
-        per_edge = w[view.key, view.nbr].astype(jnp.int32)
-    else:
-        # per-edge common smaller-neighbor count: dot of M columns a and b
-        per_edge = jnp.sum(m[:, view.key] & m[:, view.nbr], axis=0)
-    return jnp.sum(jnp.where(uniq, per_edge, 0))
+    return _wedge_count_from_adj(
+        adj, view.key, view.nbr, view.valid, n, method
+    )
 
 
-def _check_arrival_budget(seen_host: int, chunk) -> int:
-    """Arrival indices are i32: raise before they can wrap (detect-and-
-    raise discipline — a wrapped index would silently invert the
-    closing-edge comparison)."""
-    seen_host += int(np.asarray(chunk.valid).sum())
-    if seen_host >= segments.INT_MAX - chunk.capacity:
-        raise ValueError(
-            f"arrival-index budget exhausted after {seen_host} edges "
-            f"(i32 indices); restart the summary or shard the stream"
-        )
-    return seen_host
+def _needs_rebase(seen_host: int, chunk, budget: int) -> bool:
+    """Arrival indices are i32: rebase the summary before they can wrap
+    (a wrapped index would silently invert the closing-edge comparison).
+
+    The rebase is LOSSLESS: stored indices are only ever compared against
+    the arrival index of a *later* edge (the closing-edge attribution
+    rule; stored entries are never compared to each other — duplicates
+    dedup before insertion), so collapsing every present entry to -1 and
+    resetting ``n_seen`` to 0 preserves all future comparisons exactly
+    while freeing the whole i32 range for the next ~2^31 arrivals.
+    ``budget`` is INT_MAX in production; tests shrink it to exercise the
+    rebase without streaming 2^31 edges.
+    """
+    return seen_host + int(np.asarray(chunk.valid).sum()) >= (
+        budget - chunk.capacity
+    )
+
+
+@jax.jit
+def _rebase_dense(state: "TriangleCounts") -> "TriangleCounts":
+    adj = jnp.where(
+        state.adj != segments.INT_MAX, -1, segments.INT_MAX
+    ).astype(jnp.int32)
+    return state._replace(adj=adj, n_seen=jnp.zeros((), jnp.int32))
+
+
+@jax.jit
+def _rebase_sparse(state: "SparseTriangleCounts") -> "SparseTriangleCounts":
+    aidx = jnp.where(
+        state.aidx != segments.INT_MAX, -1, segments.INT_MAX
+    ).astype(jnp.int32)
+    return state._replace(aidx=aidx, n_seen=jnp.zeros((), jnp.int32))
 
 
 def _check_slot_range(capacity: int, full_capacity: int, *arrays_with_mask):
@@ -528,19 +562,17 @@ def sharded_window_triangles(stream, window_ms: int,
             v = jax.tree.map(lambda x: x[0], v)
             key = jnp.where(v.valid, v.key, 0)
             nbr = jnp.where(v.valid, v.nbr, 0)
-            part = jnp.zeros((n, n), jnp.int32).at[key, nbr].max(
-                v.valid.astype(jnp.int32), mode="drop"
+            # uint8 partials: the psum'd scratch is n^2 bytes per device,
+            # matching the single-device kernel's bool adjacency footprint.
+            part = jnp.zeros((n, n), jnp.uint8).at[key, nbr].max(
+                v.valid.astype(jnp.uint8), mode="drop"
             )
             adj = jax.lax.psum(part, SHARD_AXIS) > 0
-            cols = jnp.arange(n, dtype=jnp.int32)
-            wedge = adj & (cols[None, :] > cols[:, None])
-            # Unique canonical edges: with direction ALL, (a, b) a < b lands
-            # only on a's owner, so a per-device first-occurrence mask
-            # dedups globally.
-            canon = v.valid & (v.key < v.nbr)
-            uniq = segments.unique_pairs_mask(v.key, v.nbr, canon, n)
-            per_edge = jnp.sum(wedge[:, key] & wedge[:, nbr], axis=0)
-            local = jnp.sum(jnp.where(uniq, per_edge, 0))
+            # Per-device matching over owned canonical edges: with
+            # direction ALL, (a, b) a < b lands only on a's owner, so the
+            # helper's per-device first-occurrence dedup is globally
+            # correct.
+            local = _wedge_count_from_adj(adj, v.key, v.nbr, v.valid, n)
             return jax.lax.psum(local, SHARD_AXIS)[None]
 
         out = mesh_lib.shard_map_fn(
@@ -673,12 +705,15 @@ class ExactTriangleStream:
     observable {vertex: count, -1: global} map (SumAndEmitCounters,
     ExactTriangleCount.java:121-134)."""
 
-    def __init__(self, stream, capacity: int | None = None):
+    def __init__(self, stream, capacity: int | None = None,
+                 arrival_budget: int = int(segments.INT_MAX)):
         self.stream = stream
         self.capacity = (
             int(capacity) if capacity is not None
             else stream.ctx.vertex_capacity
         )
+        self.arrival_budget = int(arrival_budget)
+        self.stats = {"rebases": 0}
 
     def __iter__(self) -> Iterator[TriangleCounts]:
         n = self.capacity
@@ -689,7 +724,11 @@ class ExactTriangleStream:
                 n, self.stream.ctx.vertex_capacity,
                 (c.src, c.valid), (c.dst, c.valid),
             )
-            seen_host = _check_arrival_budget(seen_host, c)
+            if _needs_rebase(seen_host, c, self.arrival_budget):
+                state = _rebase_dense(state)
+                seen_host = 0
+                self.stats["rebases"] += 1
+            seen_host += int(np.asarray(c.valid).sum())
             state = _exact_step(state, c)
             yield state
 
@@ -716,12 +755,18 @@ class ExactTriangleStream:
 
 
 def exact_triangle_count(stream, capacity: int | None = None,
-                         max_degree: int | None = None):
+                         max_degree: int | None = None,
+                         arrival_budget: int = int(segments.INT_MAX)):
     """Exact streaming triangle counts.
 
     ``max_degree=None`` → dense arrival-index matrix (O(N^2) memory, the
     small-N fast path); ``max_degree=D`` → capped-degree sparse table
     (O(N*D) memory, the N >= 1M path; degree overflow raises).
+
+    Arrival indices are i32; when the stream approaches ``arrival_budget``
+    edges (default ~2^31) the summary is REBASED in place — a lossless
+    reset of stored indices (see :func:`_needs_rebase`) — so unbounded
+    streams never stop or lose counts. ``stats["rebases"]`` counts them.
 
     Overflow contract (sparse path): overflow checks are deferred by one
     chunk to preserve dispatch pipelining, so the iterator may yield ONE
@@ -730,8 +775,11 @@ def exact_triangle_count(stream, capacity: int | None = None,
     (0 = clean); ``final()``/``final_counts()`` never observe a corrupt
     state (the raise fires first)."""
     if max_degree is not None:
-        return SparseExactTriangleStream(stream, max_degree, capacity)
-    return ExactTriangleStream(stream, capacity)
+        return SparseExactTriangleStream(
+            stream, max_degree, capacity, arrival_budget=arrival_budget
+        )
+    return ExactTriangleStream(stream, capacity,
+                               arrival_budget=arrival_budget)
 
 
 # --------------------------------------------------------------------- #
@@ -880,7 +928,8 @@ class SparseExactTriangleStream:
     O(N * max_degree)."""
 
     def __init__(self, stream, max_degree: int, capacity: int | None = None,
-                 slab: int | None = None):
+                 slab: int | None = None,
+                 arrival_budget: int = int(segments.INT_MAX)):
         self.stream = stream
         self.max_degree = int(max_degree)
         self.capacity = (
@@ -892,6 +941,8 @@ class SparseExactTriangleStream:
             int(slab) if slab is not None
             else max(8, (1 << 22) // (self.max_degree ** 2))
         )
+        self.arrival_budget = int(arrival_budget)
+        self.stats = {"rebases": 0}
 
     def _overflow_error(self, n: int) -> ValueError:
         return ValueError(
@@ -908,7 +959,11 @@ class SparseExactTriangleStream:
                 self.capacity, self.stream.ctx.vertex_capacity,
                 (c.src, c.valid), (c.dst, c.valid),
             )
-            seen_host = _check_arrival_budget(seen_host, c)
+            if _needs_rebase(seen_host, c, self.arrival_budget):
+                state = _rebase_sparse(state)
+                seen_host = 0
+                self.stats["rebases"] += 1
+            seen_host += int(np.asarray(c.valid).sum())
             state = _sparse_exact_step(state, c, self.max_degree, self.slab)
             # Check the PREVIOUS chunk's overflow after dispatching the
             # current one: the host sync lands on an already-finished

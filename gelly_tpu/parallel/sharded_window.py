@@ -61,7 +61,7 @@ class ShardedSnapshotStream:
 
     def __init__(self, stream, window_ms: int, direction: str = "out",
                  window_capacity: int | None = None, mesh=None,
-                 bucket_slack: float = 2.0):
+                 bucket_slack: float = 2.0, allowed_lateness: int = 0):
         if direction not in ("out", "in", "all"):
             raise ValueError(f"direction must be out/in/all, got {direction}")
         self.stream = stream
@@ -71,6 +71,7 @@ class ShardedSnapshotStream:
         self.S = mesh_lib.num_shards(self.mesh)
         self.bucket_slack = bucket_slack
         self.window_capacity = window_capacity
+        self.allowed_lateness = int(allowed_lateness)
         # Validates divisibility of the vertex space by the mesh.
         partition.slots_per_shard(stream.ctx.vertex_capacity, self.S)
         self.stats = {"late_edges": 0, "windows_closed": 0, "dropped": 0}
@@ -184,7 +185,8 @@ class ShardedSnapshotStream:
         plan = None
         buf = None
         for kind, w, chunk, n_valid in tumbling_window_events(
-            self._transformed(), self.window_ms, self.stats
+            self._transformed(), self.window_ms, self.stats,
+            allowed_lateness=self.allowed_lateness,
         ):
             if plan is None and kind == "edges":
                 plan = self._plan(chunk.capacity, chunk.val.dtype)
@@ -331,8 +333,10 @@ class ShardedSnapshotStream:
 
 def sharded_slice(stream, window_ms: int, direction: str = "out",
                   window_capacity: int | None = None, mesh=None,
-                  bucket_slack: float = 2.0) -> ShardedSnapshotStream:
+                  bucket_slack: float = 2.0,
+                  allowed_lateness: int = 0) -> ShardedSnapshotStream:
     """Mesh form of ``SimpleEdgeStream.slice`` (M/SimpleEdgeStream.java:135-167)."""
     return ShardedSnapshotStream(
-        stream, window_ms, direction, window_capacity, mesh, bucket_slack
+        stream, window_ms, direction, window_capacity, mesh, bucket_slack,
+        allowed_lateness,
     )
