@@ -117,20 +117,29 @@ def _tumbling_with_lateness(
         ok = np.asarray(c.valid)
         if not ok.any():
             continue
-        hi = int(ts[ok].max())
-        max_ts = hi if max_ts is None else max(max_ts, hi)
-        # Any future edge has ts >= watermark (the lateness bound), hence
-        # lands in window >= upto: everything below can close.
-        upto = (max_ts - lateness) // window_ms
-        if pending:
-            yield from flush(upto)
-        if closed_upto is None or upto > closed_upto:
-            closed_upto = upto
         tw = ts // window_ms
-        n_late = int((ok & (tw < closed_upto)).sum())
-        if n_late:
-            stats["late_edges"] += n_late
-            ok = ok & (tw >= closed_upto)
+        # Lateness is judged against the watermark as it stood BEFORE this
+        # chunk: an edge is late only if its window already closed. (Using
+        # this chunk's own max_ts first would make a chunk spanning more
+        # than the lateness bound drop its own earlier edges — even on a
+        # perfectly sorted stream.)
+        if closed_upto is not None:
+            n_late = int((ok & (tw < closed_upto)).sum())
+            if n_late:
+                stats["late_edges"] += n_late
+                ok = ok & (tw >= closed_upto)
+            if not ok.any():
+                continue
         for w in np.unique(tw[ok]).tolist():
             pending.setdefault(w, []).append((c, ok & (tw == w)))
+        # Now advance the watermark and flush closable windows. Any future
+        # edge has ts >= max_ts - lateness (the lateness bound), hence
+        # lands in window >= upto: everything below can close.
+        hi = int(ts[ok].max())
+        max_ts = hi if max_ts is None else max(max_ts, hi)
+        upto = (max_ts - lateness) // window_ms
+        if closed_upto is None or upto > closed_upto:
+            closed_upto = upto
+        if pending:
+            yield from flush(closed_upto)
     yield from flush(None)

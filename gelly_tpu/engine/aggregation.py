@@ -421,6 +421,11 @@ def run_aggregation(
     """
     if merge_every is not None and window_ms is not None:
         raise ValueError("pass at most one of merge_every / window_ms")
+    if allowed_lateness and window_ms is None:
+        raise ValueError(
+            "allowed_lateness requires window_ms (merge_every mode is "
+            "count-based and does not reorder by timestamp)"
+        )
     if allowed_lateness and checkpoint_path:
         # Chunk-boundary checkpoints assume every consumed edge is already
         # folded; the lateness reorder buffer holds consumed-but-unfolded

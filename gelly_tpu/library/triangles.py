@@ -143,40 +143,40 @@ def _check_slot_range(capacity: int, full_capacity: int, *arrays_with_mask):
             )
 
 
-@partial(jax.jit, static_argnames=("n", "capacity", "method", "mirror"))
+@partial(jax.jit, static_argnames=("n", "capacity", "method"))
 def _window_triangle_count_packed(packed: jax.Array, n: int, capacity: int,
-                                  method: str,
-                                  mirror: bool = False) -> jax.Array:
-    """Packed-wire variant: ``packed[i] = key*n + nbr`` (INT_MAX padding).
+                                  method: str) -> jax.Array:
+    """Packed-wire variant: ``packed[i] = a*n + b`` with ``a < b`` — the
+    window's UNIQUE canonical undirected edges (host-deduped, self-loops
+    removed), INT_MAX padding.
 
-    The window view's key/nbr/valid columns compress into one i32 on the
-    host — the H2D transfer is the dominant window cost on a
-    bandwidth-limited link, and the triangle count never reads ``val``.
-
-    With ``mirror`` the packed column carries each window edge ONCE (the
-    OUT-direction buffer) and the ALL-direction doubled view the count
-    kernel expects is reconstructed on device — both directions of an edge
-    always share the edge's timestamp window, so symmetrizing after the
-    transfer is exact and halves the wire bytes again.
+    The H2D transfer is the dominant window cost on a bandwidth-limited
+    link, so the wire carries exactly one i32 lane per undirected window
+    edge; the ALL-direction adjacency is rebuilt on device (both
+    directions share the edge's timestamp window, so symmetrizing after
+    the transfer is exact). Host dedup also removes every device-side
+    sort/first-occurrence pass: per-edge counting runs on exactly one
+    canonical lane per edge (the GenerateCandidateEdges wedge-center
+    semantics of :func:`_wedge_count_from_adj`, with the canon/uniq masks
+    statically true).
     """
     valid = packed != segments.INT_MAX
     safe = jnp.where(valid, packed, 0)
-    key = (safe // n).astype(jnp.int32)
-    nbr = (safe % n).astype(jnp.int32)
-    if mirror:
-        key, nbr = (
-            jnp.concatenate([key, nbr]), jnp.concatenate([nbr, key])
-        )
-        valid = jnp.concatenate([valid, valid])
-    view = NeighborhoodView(
-        key=jnp.where(valid, key, segments.INT_MAX),
-        nbr=nbr,
-        val=jnp.zeros((), jnp.float32),  # unused by the count
-        valid=valid,
-        starts=jnp.zeros_like(valid),  # unused by the count
-        seg_id=jnp.zeros_like(key),  # unused by the count
-    )
-    return _window_triangle_count(view, capacity, method)
+    a = (safe // n).astype(jnp.int32)
+    b = (safe % n).astype(jnp.int32)
+    adj = jnp.zeros((capacity, capacity), bool)
+    adj = adj.at[a, b].max(valid, mode="drop")
+    adj = adj.at[b, a].max(valid, mode="drop")
+    cols = jnp.arange(capacity, dtype=jnp.int32)
+    m = adj & (cols[None, :] > cols[:, None])
+    if method.startswith("mxu"):
+        from ..ops.pallas_kernels import wedge_count_matrix
+
+        w = wedge_count_matrix(m, interpret=method == "mxu_interpret")
+        per_edge = w[a, b].astype(jnp.int32)
+    else:
+        per_edge = jnp.sum(m[:, a] & m[:, b], axis=0)
+    return jnp.sum(jnp.where(valid, per_edge, 0))
 
 
 @partial(jax.jit, static_argnames=("n", "max_degree", "slab"))
@@ -309,13 +309,20 @@ def _out_windows(stream, window_ms: int, window_capacity: int | None,
 
 def _packed_out_windows(stream, window_ms: int, window_capacity: int | None,
                         n: int) -> Iterator[tuple[int, np.ndarray]]:
-    """(window, packed i32 host column): key*n + nbr, INT_MAX padding —
-    half the wire bytes of separate columns (requires n^2 < 2^31)."""
+    """(window, packed i32 host column): ``key*n + nbr`` of the window's
+    UNIQUE directed edges, ascending, no padding (requires n^2 < 2^31).
+
+    Deduping on the host (np.unique) before the transfer is the wire win:
+    the count kernel only needs each directed edge once, and real streams
+    repeat hot pairs heavily (the bench's Zipf windows carry ~3x
+    duplicates), so the shipped column is ∝ unique edges instead of the
+    padded window capacity. Callers bucket-pad per dispatch group."""
     for w, (bk, bn, bo) in _out_windows(stream, window_ms,
                                         window_capacity, n):
-        yield w, np.where(
-            bo, bk.astype(np.int64) * n + bn, segments.INT_MAX
-        ).astype(np.int32)
+        a = np.minimum(bk[bo], bn[bo]).astype(np.int64)
+        b = np.maximum(bk[bo], bn[bo]).astype(np.int64)
+        keep = a != b  # self-loops close no triangles
+        yield w, np.unique(a[keep] * n + b[keep]).astype(np.int32)
 
 
 def window_triangle_counts_device(stream, window_ms: int,
@@ -357,16 +364,14 @@ def _window_triangle_count_packed_group(packed_kl: jax.Array, n: int,
                                         ) -> jax.Array:
     """Count triangles for a GROUP of packed windows in one dispatch.
 
-    ``packed_kl`` is ``i32[K, L]`` — K single-copy (mirror) window columns
+    ``packed_kl`` is ``i32[K, L]`` — K canonical-unique window columns
     stacked on the host. ``lax.map`` runs the per-window count sequentially
     on device, so HBM holds one window's dense state at a time while the
     host pays one transfer + one dispatch for the whole group (the same
     fixed-cost amortization as the engine's ``fold_batch``).
     """
     return jax.lax.map(
-        lambda p: _window_triangle_count_packed(
-            p, n, capacity, method, mirror=True
-        ),
+        lambda p: _window_triangle_count_packed(p, n, capacity, method),
         packed_kl,
     )
 
@@ -395,7 +400,7 @@ def window_triangle_counts_batched(stream, window_ms: int,
     :func:`window_triangle_counts_device` but amortizes the per-transfer
     fixed cost over the group — the window-path analog of the engine's
     ``fold_batch`` (emission latency grows by up to ``batch - 1`` windows;
-    the final partial group is padded with empty windows, which count 0).
+    the final partial group dispatches at its own smaller size).
 
     ``max_degree`` selects the capped-degree sparse kernel
     (:func:`_window_triangle_count_sparse`) — the ONLY path for large
@@ -473,22 +478,35 @@ def window_triangle_counts_batched(stream, window_ms: int,
 
     pick = _pick_method(method, n)
 
-    def flush(group):
+    def stage(group):
+        # Host assembly + H2D on the prefetch thread, overlapping the
+        # device counts of earlier groups (the engine's stage_unit
+        # pattern). Columns are deduped/compact; pad the group to a shared
+        # power-of-two bucket so the compiled kernel sees O(log) shapes.
         k = len(group)
         wins = [w for w, _ in group]
         cols = [c for _, c in group]
-        if k < batch:
-            cols += [np.full_like(cols[0], segments.INT_MAX)] * (batch - k)
-        stacked = np.stack(cols)
+        longest = max(c.shape[0] for c in cols)
+        bucket = max(1024, 1 << max(0, longest - 1).bit_length())
+        # k rows, not batch: a padded row would still compute a full
+        # adjacency + count on device. Only the final partial group
+        # compiles a second (smaller) K.
+        stacked = np.full((k, bucket), segments.INT_MAX, np.int32)
+        for i, c in enumerate(cols):
+            stacked[i, : c.shape[0]] = c
+        return wins, k, jax.device_put(stacked)
+
+    from ..utils.prefetch import prefetch_map
+
+    for wins, k, stacked in prefetch_map(
+        stage,
+        in_groups(_packed_out_windows(stream, window_ms, window_capacity, n)),
+        depth=2, workers=1,
+    ):
         counts = _window_triangle_count_packed_group(
             stacked, n, n, pick(2 * stacked.shape[1])
         )
-        return list(zip(wins, [counts[i] for i in range(k)]))
-
-    for group in in_groups(
-        _packed_out_windows(stream, window_ms, window_capacity, n)
-    ):
-        yield from flush(group)
+        yield from zip(wins, (counts[i] for i in range(k)))
 
 
 def window_triangles(stream, window_ms: int, capacity: int | None = None,

@@ -285,3 +285,51 @@ def test_allowed_lateness_engine_window_mode():
     # Same number of windows, same final labels.
     assert len(sorted_runs) == len(shuffled_runs)
     np.testing.assert_array_equal(sorted_runs[-1], shuffled_runs[-1])
+
+
+def test_allowed_lateness_sorted_stream_unaffected():
+    # Regression: a chunk spanning more than the lateness bound must not
+    # drop its own earlier edges — on a sorted stream, lateness>0 must be
+    # a no-op (same windows, zero late edges) even when one chunk covers
+    # many windows.
+    from gelly_tpu.core.io import EdgeChunkSource, TimeCharacteristic
+    from gelly_tpu.core.stream import edge_stream_from_source
+    from gelly_tpu.core.vertices import IdentityVertexTable
+
+    n, n_v = 256, 16
+    rng = np.random.default_rng(31)
+    src = rng.integers(0, n_v, n).astype(np.int64)
+    dst = rng.integers(0, n_v, n).astype(np.int64)
+    ts = np.arange(n, dtype=np.int64) * 16  # 0..4080: chunk spans ~3200ms
+
+    def collect(L):
+        s = edge_stream_from_source(
+            EdgeChunkSource(src, dst, timestamps=ts, chunk_size=200,
+                            table=IdentityVertexTable(n_v),
+                            time=TimeCharacteristic.EVENT),
+            n_v,
+        )
+        snap = s.slice(100, "out", window_capacity=2 * n,
+                       allowed_lateness=L)
+        out = {}
+        for upd in snap.reduce_on_edges(lambda a, b: a + b):
+            ok = np.asarray(upd.valid).astype(bool)
+            out[upd.window] = dict(
+                zip(np.asarray(upd.slots)[ok].tolist(),
+                    np.asarray(upd.values)[ok].tolist())
+            )
+        return out, snap.stats["late_edges"]
+
+    want, late0 = collect(0)
+    got, late = collect(50)  # bound << chunk ts span
+    assert late0 == 0 and late == 0
+    assert got == want
+
+
+def test_allowed_lateness_requires_window_mode():
+    from gelly_tpu.library.connected_components import connected_components
+
+    s = cc_stream()
+    agg = connected_components(s.ctx.vertex_capacity, ingest_combine=False)
+    with pytest.raises(ValueError, match="allowed_lateness"):
+        s.aggregate(agg, merge_every=2, allowed_lateness=10).result()
