@@ -614,11 +614,32 @@ def bench_triangles(args):
     dt_sp = float("inf")
     for _ in range(2):
         t0 = time.perf_counter()
-        _, cs = zip(*window_triangle_counts_batched(
+        ws_sp, cs = zip(*window_triangle_counts_batched(
             stream_sp(), n_sp // 10, **sp_kw
         ))
-        np.asarray(jnp.stack(cs))
+        cs = np.asarray(jnp.stack(cs))
         dt_sp = min(dt_sp, time.perf_counter() - t0)
+    if not args.skip_parity:
+        # Same host set-intersection oracle pattern as the dense workload:
+        # a published sparse_kernel_eps must be for correct counts.
+        sp_base: dict[int, int] = {}
+        wsz = n_sp // 10
+        for w0 in range(0, n_sp, wsz):
+            adj_sp: dict[int, set] = {}
+            seen_sp = set()
+            for i in range(w0, min(w0 + wsz, n_sp)):
+                a, b = int(src_sp[i]), int(dst_sp[i])
+                if a == b or (a, b) in seen_sp or (b, a) in seen_sp:
+                    continue
+                seen_sp.add((a, b))
+                adj_sp.setdefault(a, set()).add(b)
+                adj_sp.setdefault(b, set()).add(a)
+            sp_base[w0 // wsz] = sum(
+                1 for a, b in seen_sp
+                for u in adj_sp[a] & adj_sp[b] if u < min(a, b)
+            )
+        if dict(zip(ws_sp, cs.tolist())) != sp_base:
+            raise SystemExit("sparse window-triangle parity FAILED")
 
     t0 = time.perf_counter()
     base: dict[int, int] = {}

@@ -223,12 +223,22 @@ def connected_components(
     def fold_compressed_sparse(s: CCSummary, payload) -> CCSummary:
         # payload: {"v": i32[K, cap], "r": i32[K, cap]} — K chunks' counted
         # (vertex, root) pairs, -1-padded. The pairs are union edges; one
-        # joint fixpoint unions all K chunks at once.
+        # joint fixpoint unions all K chunks at once, in a compacted root
+        # space (touched slots << vertex_capacity is exactly the sparse
+        # codec's regime — union_pairs_compact keeps per-round work ∝
+        # pairs, not capacity).
         v = payload["v"].reshape(-1)
         r = payload["r"].reshape(-1)
         ok = v >= 0
         vi = jnp.where(ok, v, 0)
-        parent = unionfind.union_edges(s.parent, vi, r, ok)
+        if 4 * v.size <= n:
+            # Compacted-root-space union: per-round work ∝ pairs. Only a
+            # win while the 2L local space is comfortably below the
+            # capacity the generic fixpoint would walk per round (shapes
+            # are static, so this resolves at trace time).
+            parent = unionfind.union_pairs_compact(s.parent, vi, r, ok)
+        else:
+            parent = unionfind.union_edges(s.parent, vi, r, ok)
         seen = segments.mark_seen(s.seen, vi, ok)
         return CCSummary(parent, seen)
 

@@ -20,6 +20,16 @@ Exact edge-set parity with the reference is order-dependent; tests assert
 the spanner *properties* instead (subset of input; per-edge stretch ≤ k;
 connectivity preserved), the approach the reference's own unit test takes
 scenario-wise (``T/util/AdjacencyListGraphTest.java:57-87``).
+
+WHICH PATH TO USE: the production path is :class:`HostSpannerStream` (the
+native C++ bounded-BFS stage, multi-M edges/s, exact-parity-tested) —
+like the reference's op, the fold is a strictly sequential scalar state
+machine, the worst shape for an accelerator. The device aggregates in
+this module (``spanner_aggregation`` / ``sparse_spanner``) exist for the
+engine-plumbed mesh/combine semantics and for small streams; at measured
+4.9k edges/s (dense) / 0.4k edges/s (sparse) they are NOT peer options at
+scale, and their combine re-gates ``max_edges`` lanes sequentially —
+infeasible at the N ≥ 1M the sparse summary otherwise targets.
 """
 
 from __future__ import annotations

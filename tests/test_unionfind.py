@@ -87,3 +87,32 @@ def test_component_labels_unseen_is_minus_one():
     p = union_edges(p, jnp.array([0]), jnp.array([1]), jnp.ones(1, bool))
     lab = np.asarray(component_labels(p, seen))
     assert lab.tolist() == [0, 0, -1, -1, -1, -1, -1, -1]
+
+
+def test_union_pairs_compact_matches_union_edges():
+    import jax.numpy as jnp
+
+    from gelly_tpu.ops.unionfind import (
+        fresh_forest,
+        union_edges,
+        union_pairs_compact,
+    )
+
+    rng = np.random.default_rng(43)
+    n = 512
+    for trial in range(5):
+        src = jnp.asarray(rng.integers(0, n, 200), jnp.int32)
+        dst = jnp.asarray(rng.integers(0, n, 200), jnp.int32)
+        ok = jnp.asarray(rng.random(200) < 0.8)
+        a = union_edges(fresh_forest(n), src, dst, ok)
+        b = union_pairs_compact(fresh_forest(n), src, dst, ok)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # Chained folds (flat-input invariant maintained across calls).
+        src2 = jnp.asarray(rng.integers(0, n, 150), jnp.int32)
+        dst2 = jnp.asarray(rng.integers(0, n, 150), jnp.int32)
+        ok2 = jnp.asarray(rng.random(150) < 0.8)
+        a2 = union_edges(a, src2, dst2, ok2)
+        b2 = union_pairs_compact(b, src2, dst2, ok2)
+        np.testing.assert_array_equal(np.asarray(a2), np.asarray(b2))
+        # Result is flat (the invariant consumers rely on).
+        np.testing.assert_array_equal(np.asarray(b2), np.asarray(b2)[np.asarray(b2)])
