@@ -332,16 +332,12 @@ def device_bound_cc_payload_eps(src, dst, n_v: int, chunk_size: int,
         ))
         for lo in range(0, n_use, chunk_size)
     ]
-    stacked = agg.stack_payloads(payloads)
-    k = len(payloads)
-    # [K, cap] -> [K/batch, batch, cap]: one scan step unions a batch of
-    # chunk forests at once, mirroring the pipeline's fold_batch dispatch.
-    stacked = {
-        key: jax.device_put(
-            a.reshape(k // batch, batch, a.shape[1])
-        )
-        for key, a in stacked.items()
-    }
+    # One stacked row per fold_batch-sized group (the combining stacker
+    # pre-merges each group's chunk forests on the host, mirroring the
+    # pipeline's per-dispatch payload); the scan folds one row per step.
+    n_batches = max(1, len(payloads) // batch)
+    stacked = agg.stack_payloads(payloads, n_batches)
+    stacked = {key: jax.device_put(a) for key, a in stacked.items()}
 
     @jax.jit
     def run(state, pl):
@@ -911,7 +907,10 @@ def bench_cc_large(args) -> dict:
     n_v = args.large_vertices
     n_e = args.large_edges
     chunk = args.large_chunk_size
-    merge_every = fold_batch = 8
+    # Big fold batches: each sparse-payload fixpoint costs ~rounds x
+    # (lanes + local space) on device regardless of batch, so fewer,
+    # larger dispatches win (and the codec/H2D overlap hides the host).
+    merge_every = fold_batch = 32
     src, dst = synth_edges(n_e, n_v, seed=17)
     hot_degree = int(
         (np.bincount(src, minlength=n_v) + np.bincount(dst, minlength=n_v))
@@ -961,7 +960,11 @@ def bench_cc_large(args) -> dict:
     n_base = min(n_e, 1 << 26)
     mc = multicore_baseline_block(src[:n_base], dst[:n_base], n_v)
     dev_eps = device_bound_cc_eps(src, dst, n_v, 1 << 22)
-    dev_payload_eps = device_bound_cc_payload_eps(src, dst, n_v, 1 << 21)
+    # batch matches the pipeline's fold_batch so the stacked rows mirror
+    # its per-dispatch combined payloads.
+    dev_payload_eps = device_bound_cc_payload_eps(
+        src, dst, n_v, 1 << 21, batch=fold_batch
+    )
 
     stages = {
         k: round(v["total_s"], 4)

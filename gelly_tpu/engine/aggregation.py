@@ -87,17 +87,30 @@ class SummaryAggregation:
     host_compress: Callable[[EdgeChunk], Any] | None = None
     fold_compressed: Callable[[Summary, Any], Summary] | None = None
     # Optional payload stacker for variable-length codec payloads:
-    # ``stack_payloads(list_of_payloads) -> stacked pytree`` (leading axis
-    # K). Sparse touched-slot codecs use it to pad each batch to a
-    # power-of-two bucket capacity (wire bytes track the actual touched
-    # count; the handful of bucket shapes keep jit retraces bounded).
-    # None = leaves are equal-shape and np.stack-ed generically.
-    stack_payloads: Callable[[list], Any] | None = None
+    # ``stack_payloads(list_of_payloads, groups) -> stacked pytree``
+    # (leading axis >= groups, a multiple of it). Sparse touched-slot
+    # codecs use it to pad each batch to a power-of-two bucket capacity
+    # (wire bytes track the actual touched count; the handful of bucket
+    # shapes keep jit retraces bounded), and MAY pre-combine the batch
+    # down to ``groups`` payloads on the host (a SummaryTreeReduce
+    # partial-merge level on the ingest side). ``groups`` is the mesh
+    # shard count (the batch axis splits across devices); 1 on a single
+    # shard. None = leaves are equal-shape and np.stack-ed generically.
+    stack_payloads: Callable[..., Any] | None = None
     # SummaryTreeReduce's degree knob (M/SummaryTreeReduce.java:75): when
     # set, the cross-shard combine runs as a two-phase hierarchical tree —
     # groups of S/degree shards merge first (ICI-local), then across groups
     # (DCN on multi-host meshes). None = flat butterfly / gather merge.
     merge_degree: int | None = None
+    # Declares fold(combine(a, b), c) == combine(a, fold(b, c)) — folding
+    # into an already-combined summary equals combining afterwards (true
+    # for pure edge-set summaries: CC forests, parity forests, degree
+    # vectors). With it, the single-shard non-transient plan carries ONE
+    # running summary across windows and emits transform(local) directly,
+    # skipping the per-window Merger combine — which for forest summaries
+    # is a full-capacity union fixpoint per window close. Emissions are
+    # identical; only the physical plan changes.
+    fold_accumulates: bool = False
     name: str = "aggregation"
 
 
@@ -372,7 +385,7 @@ def run_aggregation(
     device_fields: tuple[str, ...] | None = None,
     host_precombine: Callable | None = None,
     fold_batch: int = 1,
-    ingest_workers: int = 2,
+    ingest_workers: int | None = None,
     allowed_lateness: int = 0,
     timer=None,
 ) -> SummaryStream:
@@ -444,6 +457,20 @@ def run_aggregation(
                 f"merge_degree must be a positive power of two, got {d}"
             )
 
+    if ingest_workers is None:
+        # Two codec workers overlap each other's H2D waits — but only
+        # when there are two cores to run them: on a single-core host
+        # concurrent combiner calls evict each other's hash tables (the
+        # sparse codec's working set is tens of MB) and run ~2-4x slower
+        # than one worker. Count AVAILABLE cores (affinity/cgroup-aware),
+        # not installed ones.
+        import os
+
+        try:
+            avail = len(os.sched_getaffinity(0))
+        except AttributeError:
+            avail = os.cpu_count() or 1
+        ingest_workers = min(2, avail)
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)
     plan = _compiled_plan(agg, m)
@@ -477,6 +504,10 @@ def run_aggregation(
 
     stats = {"late_edges": 0, "windows_closed": 0, "chunks": 0}
 
+    # The accumulate plan (see SummaryAggregation.fold_accumulates): one
+    # running summary, no per-window Merger combine.
+    accum = agg.fold_accumulates and not agg.transient and S == 1
+
     def gen():
         locals_ = locals0
         global_summary = agg.init()
@@ -499,9 +530,22 @@ def run_aggregation(
             global_summary = jax.tree.map(jnp.asarray, global_summary)
             current_window = meta_in.get("current_window")
             windows_closed = last_ckpt_windows = meta_in.get("windows", 0)
+            if accum:
+                # The running summary IS the restored global: folds resume
+                # into it directly.
+                locals_ = global_summary
 
         def close_window():
             nonlocal locals_, global_summary, windows_closed, dirty
+            if accum:
+                global_summary = locals_  # carried across windows, no reset
+                dirty = False
+                windows_closed += 1
+                stats["windows_closed"] = windows_closed
+                return (
+                    transform_fn(global_summary)
+                    if transform_fn else global_summary
+                )
             window_summary = merge_locals(locals_)
             if agg.transient:
                 # Reference Merger with transientState: emit
@@ -530,11 +574,14 @@ def run_aggregation(
             if not force and windows_closed - last_ckpt_windows < checkpoint_every:
                 return
             last_ckpt_windows = windows_closed
-            snap = (
-                merger_step(merge_locals(locals_), global_summary)
-                if dirty
-                else global_summary
-            )
+            if accum:
+                snap = locals_  # the running summary holds every edge
+            else:
+                snap = (
+                    merger_step(merge_locals(locals_), global_summary)
+                    if dirty
+                    else global_summary
+                )
             from .checkpoint import save_checkpoint
 
             save_checkpoint(
@@ -634,16 +681,20 @@ def run_aggregation(
                     if k < batch:
                         payloads += [identity_payload] * (batch - k)
                     if agg.stack_payloads is not None:
-                        stacked = agg.stack_payloads(payloads)
+                        stacked = agg.stack_payloads(payloads, max(S, 1))
                     else:
                         stacked = jax.tree.map(
                             lambda *ls: np.stack(ls), *payloads
                         )
                     if S > 1:
-                        # [K, ...] -> [S, K/S, ...]: chunk-data-parallel
-                        # split of the batch axis across devices.
+                        # [K', ...] -> [S, K'/S, ...]: chunk-data-parallel
+                        # split of the batch axis across devices (a
+                        # combining stacker may have reduced K to K' =
+                        # any multiple of S).
                         stacked = jax.tree.map(
-                            lambda x: x.reshape((S, batch // S) + x.shape[1:]),
+                            lambda x: x.reshape(
+                                (S, x.shape[0] // S) + x.shape[1:]
+                            ),
                             stacked,
                         )
                 with timer("h2d"):

@@ -206,7 +206,9 @@ def bipartiteness_check(vertex_capacity: int,
         return {"v": v, "r": r, "p": p.astype(np.int8),
                 "conflict": np.bool_(conflict)}
 
-    def stack_sparse(payloads: list) -> dict:
+    def stack_sparse(payloads: list, groups: int = 1) -> dict:
+        # No host-side group combine here (unlike CC): the stacked rows
+        # stay one-per-chunk; ``groups`` only names the mesh split.
         from ..engine.aggregation import bucket_stack_payloads
 
         return bucket_stack_payloads(payloads, {"v": -1, "r": 0, "p": 0})
@@ -245,6 +247,7 @@ def bipartiteness_check(vertex_capacity: int,
         stack_payloads=(
             stack_sparse if (ingest_combine and sparse) else None
         ),
+        fold_accumulates=True,  # parity forests are pure edge-set summaries
         name="bipartiteness-check",
     )
 

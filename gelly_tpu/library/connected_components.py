@@ -215,9 +215,35 @@ def connected_components(
             v, r = cc_pairs_numpy(chunk.src, chunk.dst, chunk.valid, n)
         return {"v": v, "r": r}
 
-    def stack_sparse(payloads: list) -> dict:
+    def _combine_pairs(av: np.ndarray, ar: np.ndarray):
+        # Pairs are union edges: one more sparse-combiner pass merges a
+        # whole group's chunk forests into one (the SummaryTreeReduce
+        # partial-merge level run on the ingest side).
+        from ..utils import native
+
+        if native.sparse_codecs_available():
+            return native.cc_chunk_combine_sparse(av, ar, None, n)
+        return cc_pairs_numpy(av, ar, None, n)
+
+    def stack_sparse(payloads: list, groups: int = 1) -> dict:
         from ..engine.aggregation import bucket_stack_payloads
 
+        if len(payloads) > groups:
+            size = -(-len(payloads) // groups)
+            combined = []
+            for i in range(0, len(payloads), size):
+                grp = payloads[i:i + size]
+                v, r = _combine_pairs(
+                    np.concatenate([q["v"] for q in grp]),
+                    np.concatenate([q["r"] for q in grp]),
+                )
+                combined.append({"v": v, "r": r})
+            # Pad to exactly `groups` rows (the mesh split needs it).
+            while len(combined) < groups:
+                combined.append(
+                    {"v": np.empty(0, np.int32), "r": np.empty(0, np.int32)}
+                )
+            payloads = combined
         return bucket_stack_payloads(payloads, {"v": -1, "r": 0})
 
     def fold_compressed_sparse(s: CCSummary, payload) -> CCSummary:
@@ -275,6 +301,7 @@ def connected_components(
         stack_payloads=(
             stack_sparse if (ingest_combine and sparse) else None
         ),
+        fold_accumulates=True,  # CC forests are pure edge-set summaries
         name=f"connected-components-{merge}",
     )
 

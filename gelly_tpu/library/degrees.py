@@ -121,7 +121,9 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
             )
         return {"v": v, "d": d}
 
-    def stack_sparse(payloads: list) -> dict:
+    def stack_sparse(payloads: list, groups: int = 1) -> dict:
+        # No host-side group combine here (unlike CC): the stacked rows
+        # stay one-per-chunk; ``groups`` only names the mesh split.
         from ..engine.aggregation import bucket_stack_payloads
 
         return bucket_stack_payloads(payloads, {"v": -1, "d": 0})
@@ -151,6 +153,7 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
         stack_payloads=(
             stack_sparse if (ingest_combine and sparse) else None
         ),
+        fold_accumulates=True,  # degree vectors add elementwise
         name="degree-aggregate",
     )
 
