@@ -131,6 +131,26 @@ def resolve_sparse_codec(codec: str, vertex_capacity: int) -> bool:
     )
 
 
+def group_combine_payloads(payloads: list, groups: int,
+                           combine_fn: Callable[[list], dict],
+                           empty_payload: dict) -> list:
+    """Host pre-combine for a combining ``stack_payloads``: merge the
+    batch down to exactly ``groups`` payloads (ceil-sized contiguous
+    groups, padded with ``empty_payload`` rows so the mesh split always
+    sees ``groups`` rows). ``combine_fn(group_payloads) -> payload``.
+    """
+    if len(payloads) <= groups:
+        return payloads
+    size = -(-len(payloads) // groups)
+    combined = [
+        combine_fn(payloads[i:i + size])
+        for i in range(0, len(payloads), size)
+    ]
+    while len(combined) < groups:
+        combined.append(empty_payload)
+    return combined
+
+
 def bucket_stack_payloads(payloads: list, pad_values: dict,
                           min_bucket: int = 1024) -> dict:
     """Stack variable-length dict payloads to a shared power-of-two bucket.

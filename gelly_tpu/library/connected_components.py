@@ -226,24 +226,19 @@ def connected_components(
         return cc_pairs_numpy(av, ar, None, n)
 
     def stack_sparse(payloads: list, groups: int = 1) -> dict:
-        from ..engine.aggregation import bucket_stack_payloads
+        from ..engine.aggregation import (
+            bucket_stack_payloads,
+            group_combine_payloads,
+        )
 
-        if len(payloads) > groups:
-            size = -(-len(payloads) // groups)
-            combined = []
-            for i in range(0, len(payloads), size):
-                grp = payloads[i:i + size]
-                v, r = _combine_pairs(
-                    np.concatenate([q["v"] for q in grp]),
-                    np.concatenate([q["r"] for q in grp]),
-                )
-                combined.append({"v": v, "r": r})
-            # Pad to exactly `groups` rows (the mesh split needs it).
-            while len(combined) < groups:
-                combined.append(
-                    {"v": np.empty(0, np.int32), "r": np.empty(0, np.int32)}
-                )
-            payloads = combined
+        payloads = group_combine_payloads(
+            payloads, groups,
+            lambda grp: dict(zip(("v", "r"), _combine_pairs(
+                np.concatenate([q["v"] for q in grp]),
+                np.concatenate([q["r"] for q in grp]),
+            ))),
+            {"v": np.empty(0, np.int32), "r": np.empty(0, np.int32)},
+        )
         return bucket_stack_payloads(payloads, {"v": -1, "r": 0})
 
     def fold_compressed_sparse(s: CCSummary, payload) -> CCSummary:

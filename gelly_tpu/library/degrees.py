@@ -122,10 +122,25 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
         return {"v": v, "d": d}
 
     def stack_sparse(payloads: list, groups: int = 1) -> dict:
-        # No host-side group combine here (unlike CC): the stacked rows
-        # stay one-per-chunk; ``groups`` only names the mesh split.
-        from ..engine.aggregation import bucket_stack_payloads
+        from ..engine.aggregation import (
+            bucket_stack_payloads,
+            group_combine_payloads,
+        )
 
+        def combine(grp: list) -> dict:
+            # Net deltas sum by vertex — fewer, duplicate-free device
+            # lanes per dispatch. i64 output: a group sums fold_batch
+            # chunks' i32 deltas, so the per-chunk bound no longer holds.
+            v, d = _sum_deltas(
+                np.concatenate([q["v"] for q in grp]),
+                np.concatenate([q["d"] for q in grp]).astype(np.int64),
+            )
+            return {"v": v, "d": d}
+
+        payloads = group_combine_payloads(
+            payloads, groups, combine,
+            {"v": np.empty(0, np.int32), "d": np.empty(0, np.int64)},
+        )
         return bucket_stack_payloads(payloads, {"v": -1, "d": 0})
 
     def fold_compressed_sparse(deg, payload):
@@ -158,6 +173,16 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
     )
 
 
+def _sum_deltas(ids: np.ndarray, deltas: np.ndarray):
+    """Sum deltas by vertex id, dropping zero nets. Accumulates in the
+    deltas dtype — callers summing across chunks pass i64."""
+    uniq, inv = np.unique(ids, return_inverse=True)
+    acc = np.zeros(uniq.shape[0], deltas.dtype)
+    np.add.at(acc, inv, deltas)
+    nz = acc != 0
+    return uniq[nz].astype(np.int32), acc[nz]
+
+
 def degree_pairs_numpy(src, dst, event, valid, n_v: int,
                        count_out: bool = True, count_in: bool = True):
     """Pure-numpy fallback for the native sparse degree codec: counted
@@ -185,11 +210,8 @@ def degree_pairs_numpy(src, dst, event, valid, n_v: int,
         return np.empty(0, np.int32), np.empty(0, np.int32)
     if ids.min() < 0 or ids.max() >= n_v:
         raise ValueError("degree_pairs_numpy: vertex slot out of range")
-    uniq, inv = np.unique(ids, return_inverse=True)
-    acc = np.zeros(uniq.shape[0], np.int64)
-    np.add.at(acc, inv, deltas)
-    nz = acc != 0
-    return uniq[nz].astype(np.int32), acc[nz].astype(np.int32)
+    v, d = _sum_deltas(ids, deltas)
+    return v, d.astype(np.int32)  # per-chunk nets fit i32 (native parity)
 
 
 def degree_distribution(stream, max_degree: int | None = None
