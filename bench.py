@@ -637,24 +637,29 @@ def bench_triangles(args):
         if dict(zip(ws_sp, cs.tolist())) != sp_base:
             raise SystemExit("sparse window-triangle parity FAILED")
 
-    t0 = time.perf_counter()
-    base: dict[int, int] = {}
-    for w in range(0, n_e, window_ms):
-        adj: dict[int, set] = {}
-        cnt = 0
-        seen = set()
-        for i in range(w, min(w + window_ms, n_e)):
-            a, b = int(src[i]), int(dst[i])
-            if a == b or (a, b) in seen or (b, a) in seen:
-                continue
-            seen.add((a, b))
-            adj.setdefault(a, set()).add(b)
-            adj.setdefault(b, set()).add(a)
-        for a, b in seen:
-            lo = min(a, b)
-            cnt += sum(1 for u in adj[a] & adj[b] if u < lo)
-        base[w // window_ms] = cnt
-    dt_base = time.perf_counter() - t0
+    # Best-of-2 like the accelerator side: the interpreted loop shares the
+    # single CPU core with background load, and a one-shot timing has
+    # swung the reported ratio by ~2x run to run.
+    dt_base = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        base: dict[int, int] = {}
+        for w in range(0, n_e, window_ms):
+            adj: dict[int, set] = {}
+            cnt = 0
+            seen = set()
+            for i in range(w, min(w + window_ms, n_e)):
+                a, b = int(src[i]), int(dst[i])
+                if a == b or (a, b) in seen or (b, a) in seen:
+                    continue
+                seen.add((a, b))
+                adj.setdefault(a, set()).add(b)
+                adj.setdefault(b, set()).add(a)
+            for a, b in seen:
+                lo = min(a, b)
+                cnt += sum(1 for u in adj[a] & adj[b] if u < lo)
+            base[w // window_ms] = cnt
+        dt_base = min(dt_base, time.perf_counter() - t0)
     if ours != base:
         raise SystemExit(f"triangle parity FAILED: {ours} vs {base}")
     return ("window_triangles_throughput", n_e / dt, n_e / dt_base,

@@ -134,10 +134,16 @@ def resolve_sparse_codec(codec: str, vertex_capacity: int) -> bool:
 def group_combine_payloads(payloads: list, groups: int,
                            combine_fn: Callable[[list], dict],
                            empty_payload: dict) -> list:
-    """Host pre-combine for a combining ``stack_payloads``: merge the
-    batch down to exactly ``groups`` payloads (ceil-sized contiguous
-    groups, padded with ``empty_payload`` rows so the mesh split always
-    sees ``groups`` rows). ``combine_fn(group_payloads) -> payload``.
+    """Host pre-combine for a combining ``stack_payloads``: when the
+    batch is larger than ``groups``, merge it down to exactly ``groups``
+    payloads (ceil-sized contiguous groups, padded with ``empty_payload``
+    rows so the mesh split sees ``groups`` rows).
+    ``combine_fn(group_payloads) -> payload``.
+
+    ``len(payloads) <= groups`` returns the list UNCHANGED (no padding):
+    the engine's stage path always pre-pads batches to a multiple of the
+    shard count, which is what the downstream mesh reshape needs — a
+    caller with a short, non-multiple list must pad before the split.
     """
     if len(payloads) <= groups:
         return payloads

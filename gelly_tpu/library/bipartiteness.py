@@ -221,12 +221,19 @@ def bipartiteness_check(vertex_capacity: int,
         ok = v >= 0
         vi = jnp.where(ok, v, 0)
         q = payload["p"].reshape(-1).astype(jnp.int32)
-        forest = puf.union_edges_parity(
-            s.forest._replace(
-                failed=s.forest.failed | jnp.any(payload["conflict"])
-            ),
-            vi, payload["r"].reshape(-1), q, ok,
+        base = s.forest._replace(
+            failed=s.forest.failed | jnp.any(payload["conflict"])
         )
+        if 4 * v.size <= n:
+            # Compacted-root-space parity union: per-round work ∝ pairs
+            # (same trace-time shape heuristic as the CC sparse fold).
+            forest = puf.union_pairs_parity_compact(
+                base, vi, payload["r"].reshape(-1), q, ok
+            )
+        else:
+            forest = puf.union_edges_parity(
+                base, vi, payload["r"].reshape(-1), q, ok
+            )
         seen = segments.mark_seen(s.seen, vi, ok)
         return BipartiteSummary(forest, seen)
 

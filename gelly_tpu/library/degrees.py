@@ -144,8 +144,10 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
         return bucket_stack_payloads(payloads, {"v": -1, "d": 0})
 
     def fold_compressed_sparse(deg, payload):
-        # payload: {"v": i32[K, cap], "d": i32[K, cap]} counted (vertex,
-        # net-delta) pairs, -1-padded.
+        # payload: {"v": i32[K, cap], "d": int[K, cap]} counted (vertex,
+        # net-delta) pairs, -1-padded. "d" is i32 straight from the
+        # per-chunk codec but i64 after the group pre-combine (cross-chunk
+        # sums exceed the per-chunk bound) — do NOT narrow it here.
         v = payload["v"].reshape(-1)
         ok = v >= 0
         return segments.masked_scatter_add(

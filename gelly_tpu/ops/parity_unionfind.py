@@ -23,7 +23,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .segments import masked_scatter_min
+from .segments import INT_MAX, masked_scatter_min
 
 
 class ParityForest(NamedTuple):
@@ -103,6 +103,53 @@ def union_edges_parity(f: ParityForest, u: jax.Array, v: jax.Array,
     )
     p, r = pointer_jump_parity(p, r)
     return ParityForest(p, r, failed)
+
+
+def union_pairs_parity_compact(f: ParityForest, u: jax.Array, v: jax.Array,
+                               q: jax.Array,
+                               valid: jax.Array) -> ParityForest:
+    """Parity union via a compacted root space — the large-N fast path
+    (the parity analog of :func:`~gelly_tpu.ops.unionfind.
+    union_pairs_compact`, same flat-forest requirement and the same
+    per-round-work-∝-pairs rationale).
+
+    REQUIRES a flat parity forest (``rel[i]`` = parity of i to its ROOT,
+    ``rel[root] == 0``), which :func:`union_edges_parity` and this
+    function both re-establish. Each pair's constraint transfers to its
+    roots with the root-adjusted parity ``rel[u] ^ rel[v] ^ q``; the
+    local union runs over the sorted-roots space, conflicts (odd cycles)
+    propagate through ``failed``, and the writeback + one parity-carrying
+    doubling restores global flatness (depth <= 2 after the root
+    updates).
+    """
+    pu, pv = f.parent[u], f.parent[v]
+    link_q = f.rel[u] ^ f.rel[v] ^ q
+    roots = jnp.concatenate([pu, pv])
+    ok2 = jnp.concatenate([valid, valid])
+    sorted_roots = jnp.sort(jnp.where(ok2, roots, INT_MAX))
+    # Local id = first-occurrence position: unique per root, ascending
+    # with root value (min-local-id unions keep the min-root convention).
+    lu = jnp.searchsorted(sorted_roots, pu).astype(jnp.int32)
+    lv = jnp.searchsorted(sorted_roots, pv).astype(jnp.int32)
+    local = union_edges_parity(
+        fresh_parity_forest(sorted_roots.shape[0]), lu, lv, link_q, valid
+    )
+    # Every occurrence of a root routes through its first occurrence, so
+    # all occurrences write identical (parent, rel) values; packing keeps
+    # the two fields atomic under the scatter (min = set here, belt and
+    # braces like union_pairs_compact).
+    first = jnp.searchsorted(sorted_roots, sorted_roots).astype(jnp.int32)
+    new_parent = sorted_roots[local.parent[first]]
+    new_rel = local.rel[first]
+    live = sorted_roots != INT_MAX
+    packed = f.parent * 2 + f.rel
+    packed = packed.at[jnp.where(live, sorted_roots, 0)].min(
+        jnp.where(live, new_parent * 2 + new_rel, INT_MAX), mode="drop"
+    )
+    p2, r2 = packed >> 1, packed & 1
+    return ParityForest(
+        p2[p2], r2 ^ r2[p2], f.failed | local.failed
+    )
 
 
 def merge_parity_forests(a: ParityForest, b: ParityForest) -> ParityForest:

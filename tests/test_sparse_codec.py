@@ -317,3 +317,37 @@ def test_auto_codec_threshold():
     big = connected_components(SPARSE_CODEC_MIN_CAPACITY)
     assert small.stack_payloads is None  # dense
     assert big.stack_payloads is not None  # sparse
+
+
+def test_compact_union_branch_end_to_end():
+    # At vertex_capacity >= 4 * lane count the sparse folds take the
+    # compacted-root-space unions (union_pairs_compact /
+    # union_pairs_parity_compact); run CC + bipartiteness end-to-end in
+    # that regime against oracles.
+    from gelly_tpu.library.bipartiteness import bipartiteness_check
+
+    n_v = 1 << 16
+    rng = np.random.default_rng(51)
+    src = rng.integers(0, n_v, 3000).astype(np.int64)
+    dst = rng.integers(0, n_v, 3000).astype(np.int64)
+
+    agg = connected_components(n_v, merge="gather", codec="sparse")
+    s = _stream(src, dst, chunk_size=512, n_v=n_v)
+    labels = s.aggregate(agg, merge_every=2, fold_batch=2).result()
+    assert labels_to_components(labels, s.ctx) == _host_components(src, dst)
+
+    left = rng.integers(0, n_v // 2, 2000).astype(np.int64)
+    right = (rng.integers(0, n_v // 2, 2000) + n_v // 2).astype(np.int64)
+    agg2 = bipartiteness_check(n_v, codec="sparse")
+    s2 = _stream(left, right, chunk_size=512, n_v=n_v)
+    res = s2.aggregate(agg2, merge_every=2, fold_batch=2).result()
+    assert bool(res.ok)
+    col = np.asarray(res.colors)
+    assert (col[left] ^ col[right]).all()
+    # Odd cycle deep in the stream flips ok through the compact branch.
+    s3 = _stream(np.concatenate([left, [1, 2, 3]]),
+                 np.concatenate([right, [2, 3, 1]]),
+                 chunk_size=512, n_v=n_v)
+    res3 = s3.aggregate(bipartiteness_check(n_v, codec="sparse"),
+                        merge_every=2, fold_batch=2).result()
+    assert not bool(res3.ok)

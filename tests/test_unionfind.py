@@ -116,3 +116,46 @@ def test_union_pairs_compact_matches_union_edges():
         np.testing.assert_array_equal(np.asarray(a2), np.asarray(b2))
         # Result is flat (the invariant consumers rely on).
         np.testing.assert_array_equal(np.asarray(b2), np.asarray(b2)[np.asarray(b2)])
+
+
+def test_union_pairs_parity_compact_matches_union_edges_parity():
+    import jax.numpy as jnp
+
+    from gelly_tpu.ops.parity_unionfind import (
+        fresh_parity_forest,
+        union_edges_parity,
+        union_pairs_parity_compact,
+    )
+
+    rng = np.random.default_rng(47)
+    n = 512
+    for trial in range(5):
+        f_a = f_b = fresh_parity_forest(n)
+        # Chained folds; later rounds likely create odd cycles, so both
+        # the structure AND the sticky failed bit must track.
+        for round_ in range(3):
+            m = 150
+            u = jnp.asarray(rng.integers(0, n, m), jnp.int32)
+            v = jnp.asarray(rng.integers(0, n, m), jnp.int32)
+            q = jnp.asarray(rng.integers(0, 2, m), jnp.int32)
+            ok = jnp.asarray(rng.random(m) < 0.8)
+            f_a = union_edges_parity(f_a, u, v, q, ok)
+            f_b = union_pairs_parity_compact(f_b, u, v, q, ok)
+            np.testing.assert_array_equal(
+                np.asarray(f_a.parent), np.asarray(f_b.parent),
+            )
+            assert bool(f_a.failed) == bool(f_b.failed), (trial, round_)
+            if not bool(f_a.failed):
+                # The 2-coloring is unique per component only while the
+                # constraints are consistent; after an odd cycle the
+                # coloring is undefined (the reference collapses to
+                # (false, {})) and the implementations may settle
+                # different rel values.
+                np.testing.assert_array_equal(
+                    np.asarray(f_a.rel), np.asarray(f_b.rel),
+                )
+        # Flat-forest invariant holds for the compact result.
+        p = np.asarray(f_b.parent)
+        np.testing.assert_array_equal(p, p[p])
+        r = np.asarray(f_b.rel)
+        assert (r[p == np.arange(n)] == 0).all()
