@@ -895,8 +895,8 @@ def bench_cc(args) -> dict:
         "device_fold_eps": round(dev_eps, 1),
         "device_fold_payload_eps": round(dev_payload_eps, 1),
         "device_vs_model32": round(dev_eps / mc["baseline_model32_eps"], 2),
-        # Stage seconds are thread-summed (ingest stages run on 2 workers),
-        # so they can exceed total_wall.
+        # Stage seconds are thread-summed (ingest stages may run on
+        # multiple workers), so they can exceed total_wall.
         "stages": stages,
     }
 
