@@ -964,11 +964,15 @@ def bench_cc_large(args) -> dict:
     # Baselines at scale: rate-flat, measured on a 2^26-edge prefix.
     n_base = min(n_e, 1 << 26)
     mc = multicore_baseline_block(src[:n_base], dst[:n_base], n_v)
-    dev_eps = device_bound_cc_eps(src, dst, n_v, 1 << 22)
+    # Rate-flat measurements on bounded prefixes: the raw device fold runs
+    # ~2.4M edges/s at this n_v, so a 2^25-edge staging would add ~40s of
+    # bench wall for the same figure.
+    dev_eps = device_bound_cc_eps(src, dst, n_v, 1 << 22,
+                                  max_edges=1 << 23)
     # batch matches the pipeline's fold_batch so the stacked rows mirror
     # its per-dispatch combined payloads.
     dev_payload_eps = device_bound_cc_payload_eps(
-        src, dst, n_v, 1 << 21, batch=fold_batch
+        src, dst, n_v, 1 << 20, batch=fold_batch, max_edges=1 << 25
     )
 
     stages = {
