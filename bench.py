@@ -961,7 +961,8 @@ def bench_cc_large(args) -> dict:
             }))
         parity = "pass"
 
-    # Baselines at scale: rate-flat, measured on a 2^26-edge prefix.
+    # Multicore baseline: rate-flat, measured on a 2^26-edge prefix (the
+    # device baselines below pick their own bounded prefixes).
     n_base = min(n_e, 1 << 26)
     mc = multicore_baseline_block(src[:n_base], dst[:n_base], n_v)
     # Rate-flat measurements on bounded prefixes: the raw device fold runs

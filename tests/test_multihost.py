@@ -16,7 +16,9 @@ import textwrap
 
 import pytest
 
-_WORKER = textwrap.dedent(
+# Shared join procedure for every worker: env pinning, repo path, and the
+# 2-process cluster join. Workers are PREAMBLE + body.
+_PREAMBLE = textwrap.dedent(
     """
     import os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -30,6 +32,11 @@ _WORKER = textwrap.dedent(
         num_processes=2,
         process_id=int(os.environ["PID_IDX"]),
     )
+    """
+)
+
+_WORKER = _PREAMBLE + textwrap.dedent(
+    """
     assert jax.process_count() == 2, jax.process_count()
     assert len(jax.devices()) == 2, jax.devices()
 
@@ -57,21 +64,9 @@ def test_initialize_multihost_two_processes(tmp_path):
     _run_two_process(_WORKER, "MULTIHOST_OK")
 
 
-_CC_WORKER = textwrap.dedent(
+_CC_WORKER = _PREAMBLE + textwrap.dedent(
     """
-    import os, sys
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.pop("XLA_FLAGS", None)
-    sys.path.insert(0, os.environ["REPO_ROOT"])
-    import jax
     import numpy as np
-    from gelly_tpu.parallel import mesh as mesh_lib
-
-    mesh_lib.initialize_multihost(
-        coordinator_address=os.environ["COORD"],
-        num_processes=2,
-        process_id=int(os.environ["PID_IDX"]),
-    )
 
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -176,3 +171,61 @@ def test_multihost_cc_merge_two_processes(tmp_path):
     # Per-host local fold + cross-host butterfly label merge == the
     # single-process result (identical final components).
     _run_two_process(_CC_WORKER, "MULTIHOST_CC_OK")
+
+
+_EXCHANGE_WORKER = _PREAMBLE + textwrap.dedent(
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gelly_tpu.parallel import partition
+
+    # The keyBy shuffle ACROSS PROCESSES: every entry must land on the
+    # device owning its key (striped ownership), with nothing dropped —
+    # the all_to_all riding the distributed transport instead of ICI.
+    L = 64
+    rng = np.random.default_rng(7)  # same seed both processes: global view
+    all_keys = rng.integers(0, 32, (2, L)).astype(np.int32)
+    all_pay = rng.integers(0, 1000, (2, L)).astype(np.int32)
+    pid = jax.process_index()
+
+    m = mesh_lib.make_mesh()
+    sh = NamedSharding(m, P(mesh_lib.SHARD_AXIS))
+    g_key = jax.make_array_from_callback(
+        (2, L), sh, lambda idx: jnp.asarray(all_keys[pid][None]))
+    g_pay = jax.make_array_from_callback(
+        (2, L), sh, lambda idx: jnp.asarray(all_pay[pid][None]))
+    g_ok = jax.make_array_from_callback(
+        (2, L), sh, lambda idx: jnp.ones((1, L), bool))
+
+    def body(k, p_, v):
+        k2, p2, v2, dropped = partition.repartition_by_key(
+            k[0], p_[0], v[0], 2, L  # bucket = L: worst case always fits
+        )
+        return k2[None], p2[None], v2[None], dropped[None]
+
+    spec = P(mesh_lib.SHARD_AXIS)
+    k2, p2, v2, dropped = mesh_lib.shard_map_fn(
+        m, body, in_specs=(spec,) * 3, out_specs=(spec,) * 4,
+    )(g_key, g_pay, g_ok)
+
+    def local(arr):
+        return np.asarray(jax.device_get(arr.addressable_shards[0].data))[0]
+
+    lk, lp, lv = local(k2), local(p2), local(v2)
+    assert int(local(dropped)) == 0
+    got = sorted(zip(lk[lv].tolist(), lp[lv].tolist()))
+    mine = all_keys % 2 == pid
+    want = sorted(zip(all_keys[mine].tolist(), all_pay[mine].tolist()))
+    assert got == want, (len(got), len(want))
+    print("MULTIHOST_EXCHANGE_OK", pid)
+    """
+)
+
+
+def test_multihost_keyed_exchange_two_processes(tmp_path):
+    # repartition_by_key's all_to_all over the cross-process transport:
+    # ownership + multiset conservation, zero drops.
+    _run_two_process(_EXCHANGE_WORKER, "MULTIHOST_EXCHANGE_OK")
