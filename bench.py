@@ -585,6 +585,36 @@ def bench_triangles(args):
         dt = min(dt, time.perf_counter() - t0)
     ours = dict(zip(wins, counts.tolist()))
 
+    # Device-bound kernel rate: all 10 canonical-dedup window columns
+    # pre-staged in HBM, one grouped dispatch, scalar-sized pull — what
+    # the count kernel sustains without the tunnel's per-run transfer and
+    # latency costs (the link-bound pipeline above swings ~2x run to run
+    # with shared-tunnel load; this figure is stable).
+    from gelly_tpu.library.triangles import (
+        _packed_out_windows,
+        _window_triangle_count_packed_group,
+    )
+    from gelly_tpu.ops import segments as _segments
+
+    cols = [c for _, c in _packed_out_windows(
+        stream(), window_ms, window_capacity, n_v
+    )]
+    bucket = max(1024, 1 << max(
+        0, max(c.shape[0] for c in cols) - 1
+    ).bit_length())
+    staged = np.full((len(cols), bucket), _segments.INT_MAX, np.int32)
+    for i, c in enumerate(cols):
+        staged[i, : c.shape[0]] = c
+    staged = jax.device_put(staged)
+    np.asarray(_window_triangle_count_packed_group(staged, n_v, n_v, "mxu"))
+    dt_kernel = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(_window_triangle_count_packed_group(
+            staged, n_v, n_v, "mxu"
+        ))
+        dt_kernel = min(dt_kernel, time.perf_counter() - t0)
+
     # Secondary figure: the capped-degree sparse windowed kernel (the
     # large-n_v path, VERDICT r2 weak #2 asked for it to be benchmarked).
     # Uniform endpoints: the sparse kernel targets bounded-degree windows
@@ -663,7 +693,8 @@ def bench_triangles(args):
     if ours != base:
         raise SystemExit(f"triangle parity FAILED: {ours} vs {base}")
     return ("window_triangles_throughput", n_e / dt, n_e / dt_base,
-            {"sparse_kernel_eps": round(n_sp / dt_sp, 1),
+            {"device_kernel_eps": round(n_e / dt_kernel, 1),
+             "sparse_kernel_eps": round(n_sp / dt_sp, 1),
              "sparse_kernel_vertices": n_v_sp})
 
 
