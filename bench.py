@@ -309,7 +309,9 @@ def device_bound_cc_eps(src, dst, n_v: int, chunk_size: int,
 
 def device_bound_cc_payload_eps(src, dst, n_v: int, chunk_size: int,
                                 batch: int = 8,
-                                max_edges: int = 1 << 26) -> float:
+                                max_edges: int = 1 << 26,
+                                codec: str = "sparse",
+                                compact_capacity: int | None = None) -> float:
     """Device side of the codec plan: fold_compressed over HBM-staged
     sparse payloads (+ the final label transform) — the fold the pipeline
     actually dispatches on device (the union-find partial fold runs in the
@@ -321,7 +323,10 @@ def device_bound_cc_payload_eps(src, dst, n_v: int, chunk_size: int,
     from gelly_tpu.core.chunk import make_chunk
     from gelly_tpu.library.connected_components import connected_components
 
-    agg = connected_components(n_v, merge="gather", codec="sparse")
+    agg = connected_components(n_v, merge="gather", codec=codec,
+                               compact_capacity=compact_capacity)
+    if agg.on_run_start is not None:
+        agg.on_run_start()
     n_use = min(src.shape[0], max_edges)
     chunk_size = min(chunk_size, n_use)
     batch = max(1, min(batch, n_use // chunk_size))
@@ -376,8 +381,47 @@ def device_bound_degrees_eps(src, dst, n_v: int, chunk_size: int,
                              chunk_size)
 
 
+def codec_scaling_block(src, dst, n_v: int, chunk: int,
+                        cap_edges: int = 1 << 24) -> dict:
+    """Host-codec scaling row (VERDICT r3 item 3): edges/s of the
+    per-chunk sparse combine with 1..W worker threads (the native
+    combiner releases the GIL; each worker owns whole chunks, so combiner
+    hash tables stay private). W = available cores — on this image's
+    single-core host the row degenerates gracefully to one entry, and the
+    linear story is measured rather than assumed wherever cores exist."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from gelly_tpu.core.chunk import make_chunk
+    from gelly_tpu.engine.aggregation import available_cores
+    from gelly_tpu.library.connected_components import connected_components
+
+    agg = connected_components(n_v, codec="sparse")
+    n = min(cap_edges, src.shape[0])
+    n -= n % chunk
+    chunks = [
+        make_chunk(src[lo:lo + chunk], dst[lo:lo + chunk], device=False)
+        for lo in range(0, n, chunk)
+    ]
+    avail = available_cores()
+    rates = {}
+    for w in range(1, avail + 1):
+        dt = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            if w == 1:
+                for c in chunks:
+                    agg.host_compress(c)
+            else:
+                with ThreadPoolExecutor(w) as ex:
+                    list(ex.map(agg.host_compress, chunks))
+            dt = min(dt, time.perf_counter() - t0)
+        rates[str(w)] = round(n / dt, 1)
+    return {"ingest_workers": avail, "codec_workers_eps": rates}
+
+
 def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int,
-           fold_batch: int):
+           fold_batch: int, codec: str = "auto",
+           compact_capacity: int | None = None):
     import jax
 
     from gelly_tpu import edge_stream_from_edges  # noqa: F401  (registers x64)
@@ -397,7 +441,8 @@ def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int,
     # The ingest codec (native C++ chunk combiner -> compressed forest
     # payloads -> batched device union) is the default CC plan; see
     # gelly_tpu/library/connected_components.py.
-    agg = connected_components(num_vertices, merge="gather")
+    agg = connected_components(num_vertices, merge="gather", codec=codec,
+                               compact_capacity=compact_capacity)
 
     # Warmup: compile fold/merge on a tiny prefix (same static shapes).
     warm_n = min(src.shape[0], chunk_size * fold_batch)
@@ -946,10 +991,15 @@ def bench_cc_large(args) -> dict:
     n_v = args.large_vertices
     n_e = args.large_edges
     chunk = args.large_chunk_size
-    # Big fold batches: each sparse-payload fixpoint costs ~rounds x
-    # (lanes + local space) on device regardless of batch, so fewer,
-    # larger dispatches win (and the codec/H2D overlap hides the host).
-    merge_every = fold_batch = 32
+    # Big fold batches: per-dispatch fixed costs amortize, and the host
+    # group pre-combine dedups more pairs per payload row (touched
+    # vertices grow sublinearly in window edges on skewed streams), so
+    # fewer, larger merge windows win on both sides of the link. 64
+    # chunks/window = 4 emissions over the 2^28 stream.
+    merge_every = fold_batch = 64
+    # Compact root space (codec="compact"): M bounds distinct touched
+    # vertices per run (~5.5M for this stream), NOT capacity or edges.
+    compact_m = 1 << 23
     src, dst = synth_edges(n_e, n_v, seed=17)
     hot_degree = int(
         (np.bincount(src, minlength=n_v) + np.bincount(dst, minlength=n_v))
@@ -957,7 +1007,8 @@ def bench_cc_large(args) -> dict:
     )
 
     labels, ctx, dt_tpu, timer = tpu_cc(
-        src, dst, n_v, chunk, merge_every, fold_batch
+        src, dst, n_v, chunk, merge_every, fold_batch,
+        codec="compact", compact_capacity=compact_m,
     )
     eps = n_e / dt_tpu
 
@@ -1005,9 +1056,11 @@ def bench_cc_large(args) -> dict:
     dev_eps = device_bound_cc_eps(src, dst, n_v, 1 << 22,
                                   max_edges=1 << 23)
     # batch matches the pipeline's fold_batch so the stacked rows mirror
-    # its per-dispatch combined payloads.
+    # its per-dispatch combined payloads; the full stream is staged so the
+    # once-per-window transform amortizes exactly as in the pipeline.
     dev_payload_eps = device_bound_cc_payload_eps(
-        src, dst, n_v, 1 << 20, batch=fold_batch, max_edges=1 << 25
+        src, dst, n_v, chunk, batch=fold_batch, max_edges=n_e,
+        codec="compact", compact_capacity=compact_m,
     )
 
     stages = {
@@ -1030,6 +1083,9 @@ def bench_cc_large(args) -> dict:
         "vertices": n_v,
         "hot_vertex_degree": hot_degree,
         "parity": parity,
+        "merge_window_chunks": merge_every,
+        "compact_capacity": compact_m,
+        **codec_scaling_block(src, dst, n_v, chunk),
         **mc,
         "vs_baseline_multicore": round(eps / mc["baseline_multicore_eps"], 2),
         "vs_baseline_model32": round(eps / mc["baseline_model32_eps"], 3),

@@ -91,15 +91,28 @@ def _tumbling_with_lateness(
 ) -> Iterator[tuple]:
     """Watermark-gated reorder buffer (see module docstring).
 
-    ``pending`` holds (chunk, mask) pairs per open window — chunks are
-    immutable by contract (:func:`~gelly_tpu.core.chunk.make_chunk`), so
-    buffering references is safe. Windows flush in ascending order once
-    the watermark passes their end; all of a window's edge events are
-    emitted (arrival order) immediately before its close event, so
-    consumers see the same monotone window sequence as the zero-lateness
-    iterator.
+    ``pending`` holds (chunk, index-array) pairs per open window — chunks
+    are immutable by contract (:func:`~gelly_tpu.core.chunk.make_chunk`),
+    so buffering references is safe, and masks are compacted to indices so
+    buffer memory is ∝ actually-buffered edges, not chunk capacity ×
+    overlaps. Windows flush in ascending order once the watermark passes
+    their end; all of a window's edge events are emitted (arrival order)
+    immediately before its close event, so consumers see the same monotone
+    window sequence as the zero-lateness iterator.
+
+    Buffer bound: at most ``ceil((allowed_lateness + chunk_ts_span) /
+    window_ms) + 1`` windows are open at once — the watermark trails
+    max_ts by exactly the lateness, plus whatever window range a single
+    chunk's own timestamps span before the post-chunk flush — each holding
+    references to the chunks that touched it; worst-case host memory ∝
+    that window count × chunk size. The live
+    footprint is observable via ``stats["buffered_edges"]`` /
+    ``stats["open_windows"]``, updated as edges enter and leave the
+    buffer.
     """
     stats.setdefault("late_edges", 0)
+    stats["buffered_edges"] = 0
+    stats["open_windows"] = 0
     pending: dict[int, list] = {}
     # Windows below this are closed: their edges are late (drop + count).
     closed_upto = initial_window if initial_window is not None else None
@@ -107,9 +120,13 @@ def _tumbling_with_lateness(
 
     def flush(upto):
         for w in sorted(w for w in pending if upto is None or w < upto):
-            for ch, m in pending.pop(w):
+            for ch, idx in pending.pop(w):
+                m = np.zeros(ch.capacity, bool)
+                m[idx] = True
                 mm = m if ch.is_host() else jnp.asarray(m)
-                yield ("edges", w, ch.mask(mm), int(m.sum()))
+                stats["buffered_edges"] -= idx.shape[0]
+                yield ("edges", w, ch.mask(mm), idx.shape[0])
+            stats["open_windows"] = len(pending)
             yield ("close", w, None, 0)
 
     for c in chunks:
@@ -131,7 +148,10 @@ def _tumbling_with_lateness(
             if not ok.any():
                 continue
         for w in np.unique(tw[ok]).tolist():
-            pending.setdefault(w, []).append((c, ok & (tw == w)))
+            idx = np.nonzero(ok & (tw == w))[0].astype(np.int32)
+            pending.setdefault(w, []).append((c, idx))
+            stats["buffered_edges"] += idx.shape[0]
+        stats["open_windows"] = len(pending)
         # Now advance the watermark and flush closable windows. Any future
         # edge has ts >= max_ts - lateness (the lateness bound), hence
         # lands in window >= upto: everything below can close.

@@ -97,11 +97,38 @@ class SummaryAggregation:
     # shard count (the batch axis splits across devices); 1 on a single
     # shard. None = leaves are equal-shape and np.stack-ed generically.
     stack_payloads: Callable[..., Any] | None = None
+    # True when stack_payloads mutates per-run state in STREAM order (the
+    # compact plans' persistent id assignment): the engine then numbers
+    # codec units from 0 per run and passes ``seq=`` to stack_payloads so
+    # concurrent ingest workers can take the stateful step in order
+    # (everything stateless in the stacker stays parallel).
+    stack_ordered: bool = False
+    # With stack_ordered, a unit that fails BEFORE taking its assignment
+    # turn would park every later unit's worker in await_turn forever; the
+    # engine calls this hook (with the failed unit's seq) from the staging
+    # error path so the codec can release the turn (idempotent if the
+    # unit already completed it).
+    on_stage_error: Callable[[int], None] | None = None
     # SummaryTreeReduce's degree knob (M/SummaryTreeReduce.java:75): when
     # set, the cross-shard combine runs as a two-phase hierarchical tree —
     # groups of S/degree shards merge first (ICI-local), then across groups
     # (DCN on multi-host meshes). None = flat butterfly / gather merge.
     merge_degree: int | None = None
+    # Stateful-codec lifecycle hooks (e.g. the compact-space CC plan's
+    # host id session): ``on_run_start()`` fires at the start of every
+    # run_aggregation generator (fresh run = fresh codec state — one live
+    # run per aggregation instance at a time); ``on_resume(summary)`` fires
+    # after a checkpoint load so host codec state can be rebuilt from the
+    # restored device summary.
+    on_run_start: Callable[[], None] | None = None
+    on_resume: Callable[[Summary], None] | None = None
+    # True for plans whose fold exists ONLY through the ingest codec (the
+    # compact-space plans: raw chunks carry ids the summary's compact space
+    # has no mapping for). The engine then refuses — loudly, at plan time —
+    # any configuration where the codec cannot engage (window_ms mode, or a
+    # batch that cannot align with the shard count) instead of silently
+    # falling back to the raw fold.
+    requires_codec: bool = False
     # Declares fold(combine(a, b), c) == combine(a, fold(b, c)) — folding
     # into an already-combined summary equals combining afterwards (true
     # for pure edge-set summaries: CC forests, parity forests, degree
@@ -118,6 +145,16 @@ class SummaryAggregation:
 # payload (n_v * ~4 bytes) is smaller/cheaper than touched-slot pairs;
 # above it the dense payload inverts the codec's wire compression.
 SPARSE_CODEC_MIN_CAPACITY = 1 << 20
+
+
+def available_cores() -> int:
+    """Cores this process may actually run on (affinity/cgroup-aware)."""
+    import os
+
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:
+        return os.cpu_count() or 1
 
 
 def resolve_sparse_codec(codec: str, vertex_capacity: int) -> bool:
@@ -158,7 +195,8 @@ def group_combine_payloads(payloads: list, groups: int,
 
 
 def bucket_stack_payloads(payloads: list, pad_values: dict,
-                          min_bucket: int = 1024) -> dict:
+                          min_bucket: int = 1024,
+                          quantum: int | None = None) -> dict:
     """Stack variable-length dict payloads to a shared power-of-two bucket.
 
     ``pad_values`` maps the variable-length array keys to their padding
@@ -169,11 +207,21 @@ def bucket_stack_payloads(payloads: list, pad_values: dict,
     are stacked as-is. This is the wire format of the sparse touched-slot
     codecs: payload bytes ∝ the chunk's actual touched count, never the
     vertex capacity.
+
+    ``quantum`` switches the bucket ladder from powers of two to multiples
+    of ``quantum``: distinct shapes stay bounded (≤ longest/quantum per
+    stream) while padding waste drops from up-to-2x to ≤ quantum lanes —
+    the fold kernels' gather cost scales with PADDED lanes, so at
+    multi-M pair counts the pow-of-two ladder would buy compile-cache
+    stability with up to 2x device work.
     """
     longest = max(
         (p[k].shape[0] for p in payloads for k in pad_values), default=0
     )
-    cap = max(min_bucket, 1 << max(0, longest - 1).bit_length())
+    if quantum:
+        cap = max(min_bucket, -(-longest // quantum) * quantum)
+    else:
+        cap = max(min_bucket, 1 << max(0, longest - 1).bit_length())
     out = {}
     for key in payloads[0]:
         if key in pad_values:
@@ -484,19 +532,15 @@ def run_aggregation(
             )
 
     if ingest_workers is None:
-        # Two codec workers overlap each other's H2D waits — but only
-        # when there are two cores to run them: on a single-core host
-        # concurrent combiner calls evict each other's hash tables (the
-        # sparse codec's working set is tens of MB) and run ~2-4x slower
-        # than one worker. Count AVAILABLE cores (affinity/cgroup-aware),
-        # not installed ones.
-        import os
-
-        try:
-            avail = len(os.sched_getaffinity(0))
-        except AttributeError:
-            avail = os.cpu_count() or 1
-        ingest_workers = min(2, avail)
+        # One codec worker per AVAILABLE core (affinity/cgroup-aware, not
+        # installed count): the native combiners release the GIL, so
+        # staging units scale with cores — each worker owns whole units
+        # (chunks are never split across workers), so per-worker combiner
+        # hash tables stay private and there is no cross-worker eviction
+        # thrash. On a single-core host this degenerates to one worker
+        # (two workers there evict each other's tens-of-MB working sets
+        # and run ~2-4x slower than one).
+        ingest_workers = available_cores()
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)
     plan = _compiled_plan(agg, m)
@@ -528,6 +572,17 @@ def run_aggregation(
             if batch % S:
                 use_codec = False  # no aligned batching possible
 
+    if agg.requires_codec and not use_codec:
+        raise ValueError(
+            f"aggregation '{agg.name}' folds only through its ingest codec, "
+            "but the codec cannot engage here: "
+            + ("window_ms mode carries raw chunks"
+               if window_ms is not None
+               else f"merge_every={merge_every} cannot align a payload "
+                    f"batch with the {S}-shard mesh (make merge_every a "
+                    "multiple of the shard count)")
+        )
+
     stats = {"late_edges": 0, "windows_closed": 0, "chunks": 0}
 
     # The accumulate plan (see SummaryAggregation.fold_accumulates): one
@@ -535,6 +590,8 @@ def run_aggregation(
     accum = agg.fold_accumulates and not agg.transient and S == 1
 
     def gen():
+        if agg.on_run_start is not None:
+            agg.on_run_start()
         locals_ = locals0
         global_summary = agg.init()
         current_window = None
@@ -554,6 +611,8 @@ def run_aggregation(
                 checkpoint_path, like=global_summary
             )
             global_summary = jax.tree.map(jnp.asarray, global_summary)
+            if agg.on_resume is not None:
+                agg.on_resume(global_summary)
             current_window = meta_in.get("current_window")
             windows_closed = last_ckpt_windows = meta_in.get("windows", 0)
             if accum:
@@ -659,10 +718,12 @@ def run_aggregation(
 
         def produced_units():
             # Batched producer for merge_every mode: groups of up to
-            # ``batch`` host chunks. Resume-skipped chunks are dropped here
-            # (they were consumed in the checkpointed run; chunks_consumed
-            # starts at skip_until).
+            # ``batch`` host chunks, numbered in stream order (the seq
+            # feeds ordered stackers). Resume-skipped chunks are dropped
+            # here (they were consumed in the checkpointed run;
+            # chunks_consumed starts at skip_until).
             idx = 0
+            seq = 0
             group: list = []
             it = iter(stream)
             while True:
@@ -675,10 +736,11 @@ def run_aggregation(
                     continue
                 group.append(chunk)
                 if len(group) == batch:
-                    yield group
+                    yield seq, group
+                    seq += 1
                     group = []
             if group:
-                yield group
+                yield seq, group
 
         def _pad_group(group):
             # Pad the final partial batch to the static batch size so the
@@ -699,7 +761,20 @@ def run_aggregation(
             )
             identity_payload = agg.host_compress(empty)
 
-        def stage_unit(group):
+        def stage_unit(unit):
+            seq, group = unit
+            try:
+                return _stage_unit_inner(seq, group)
+            except BaseException:
+                # Release the unit's assignment turn so units parked
+                # behind it in await_turn unwind instead of hanging the
+                # pool at interpreter exit (the error itself still
+                # propagates to the consumer via prefetch_map).
+                if agg.stack_ordered and agg.on_stage_error is not None:
+                    agg.on_stage_error(seq)
+                raise
+
+        def _stage_unit_inner(seq, group):
             k = len(group)
             if use_codec:
                 with timer("ingest_compress"):
@@ -707,7 +782,14 @@ def run_aggregation(
                     if k < batch:
                         payloads += [identity_payload] * (batch - k)
                     if agg.stack_payloads is not None:
-                        stacked = agg.stack_payloads(payloads, max(S, 1))
+                        if agg.stack_ordered:
+                            stacked = agg.stack_payloads(
+                                payloads, max(S, 1), seq=seq
+                            )
+                        else:
+                            stacked = agg.stack_payloads(
+                                payloads, max(S, 1)
+                            )
                     else:
                         stacked = jax.tree.map(
                             lambda *ls: np.stack(ls), *payloads
@@ -783,8 +865,14 @@ def run_aggregation(
                 fold_unit = fold_step
             from ..utils.prefetch import prefetch_map
 
+            # Lookahead must cover the worker pool: with depth <
+            # workers, the submitter blocks on the result queue after
+            # ~depth outstanding units and the extra workers idle (host
+            # memory per in-flight unit is the trade documented on
+            # prefetch_depth).
             for unit, k in prefetch_map(
-                stage_unit, produced_units(), depth=prefetch_depth,
+                stage_unit, produced_units(),
+                depth=max(prefetch_depth, ingest_workers),
                 workers=ingest_workers,
             ):
                 chunks_consumed += k

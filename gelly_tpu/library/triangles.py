@@ -393,7 +393,8 @@ def window_triangle_counts_batched(stream, window_ms: int,
                                    window_capacity: int | None = None,
                                    method: str = "auto",
                                    batch: int = 4,
-                                   max_degree: int | None = None
+                                   max_degree: int | None = None,
+                                   yield_overflow: bool = False
                                    ) -> Iterator[tuple]:
     """Per-window counts with up to ``batch`` closed windows per device
     dispatch: yields (window_index, device_scalar) like
@@ -411,7 +412,13 @@ def window_triangle_counts_batched(stream, window_ms: int,
     deferred by one group to preserve pipelining, so up to ``batch`` counts
     from the overflowing group may be yielded (corrupt) before the raise —
     consumers acting per yield must not treat yielded counts as final until
-    the next iteration step (or ``StopIteration``) succeeds.
+    the next iteration step (or ``StopIteration``) succeeds. Alternatively
+    ``yield_overflow=True`` yields ``(window, count, overflow)`` triples on
+    this path (``overflow`` = that window's device scalar of dropped
+    adjacency entries): pulling it syncs the host, so per-yield gating
+    costs the pipelining the default defers for — but lets a consumer
+    reject exactly the corrupt windows programmatically instead of
+    trusting iterator progress.
 
     Without ``max_degree``, capacities with capacity^2 >= 2^31 degrade to
     the unpacked dense per-window path — one transfer and dispatch per
@@ -464,7 +471,13 @@ def window_triangle_counts_batched(stream, window_ms: int,
             counts, overs = _window_triangle_count_sparse_group(
                 kk, nn, vv, n, max_degree
             )
-            return list(zip(wins, [counts[i] for i in range(k)])), (overs, k)
+            if yield_overflow:
+                out = [
+                    (wins[i], counts[i], overs[i]) for i in range(k)
+                ]
+            else:
+                out = list(zip(wins, [counts[i] for i in range(k)]))
+            return out, (overs, k)
 
         for group in in_groups(
             _out_windows(stream, window_ms, window_capacity, n)

@@ -1,0 +1,176 @@
+"""Persistent compact root space for sparse summary codecs.
+
+The large-N payload fold's cost on device was dominated by *compaction*:
+``union_pairs_compact`` re-derived a chunk-local dense id space per dispatch
+with a sort + three ``searchsorted`` passes (measured ~1.1s of the 1.3s
+dispatch at n_v=2^24 on v5e — TPU binary search is ~5M lookups/s). But the
+host ingest codec already hashes every touched vertex to build the chunk
+forest; assigning each vertex a **persistent window-scoped compact id** there
+costs one table probe per *pair* (pairs ≈ touched vertices, 10-30x fewer than
+edges on skewed streams) and removes every per-dispatch O(capacity) and
+O(P log P) device op. The device then folds pairs that are already dense in
+``[0, M)`` — a pure M-space union fixpoint.
+
+This mirrors the reference's state layout one level deeper: Flink's
+``keyBy(0)`` hash-partitions vertex state so each subtask folds into a small
+local map (``M/SummaryBulkAggregation.java:78``, ``DisjointSet``'s HashMap);
+here the ingest host owns the id→slot map and the device owns the dense
+forest over those slots.
+
+Thread-safety: ``assign``/``lookup`` take an internal lock — the engine's
+prefetch pool may stage payload groups concurrently. The FINAL summary is
+order-independent (payloads carry their ``new_base`` explicitly), but
+anything observed *between* folds is not: a vertex first seen in unit i
+must ship its (cid, vertex) record in unit i's payload, or an intermediate
+window emission / checkpoint between the folds sees the cid without its
+decode entry. Concurrent stagers therefore take assignment turns in
+stream order via :meth:`CompactIdSession.await_turn` /
+:meth:`~CompactIdSession.complete_turn` (the engine numbers codec units
+per run); the heavyweight group-combine work stays parallel.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class CompactIdSession:
+    """Window-scoped vertex-slot → compact-id assignment (first-seen order).
+
+    ``capacity`` is the compact space size M: the per-window bound on
+    distinct touched vertices. Exceeding it raises ``CompactSpaceOverflow``
+    (the caller picks M from the stream's touched-vertex scale; the engine
+    surfaces the error with sizing guidance).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._turn_cv = threading.Condition()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            # Sorted global ids + their cids (aligned): lookups are one
+            # searchsorted; inserts are a sorted merge. Both run at pair
+            # rate on the ingest thread, far off the per-edge path.
+            self._known = np.empty(0, np.int32)
+            self._cid_of = np.empty(0, np.int32)
+            self._next = 0
+        with self._turn_cv:
+            self._turn = 0
+            self._turn_cv.notify_all()
+
+    def await_turn(self, seq: int) -> None:
+        """Block until all units numbered < seq have completed their
+        assignment turn. With concurrent ingest workers, units must ASSIGN
+        in stream order: a vertex first seen (stream-wise) in unit i must
+        ship its (cid, vertex) record in unit i's payload — if unit i+1
+        assigned first, the record would ride a unit folded later than the
+        first fold referencing the cid, corrupting any window emission or
+        checkpoint taken between the two. The engine numbers codec units
+        from 0 per run and gates each unit's assign step here (combine
+        work stays unordered/parallel)."""
+        with self._turn_cv:
+            self._turn_cv.wait_for(lambda: self._turn >= seq)
+
+    def complete_turn(self, seq: int) -> None:
+        """Mark unit ``seq``'s assignment done (call in a finally: a
+        failed unit must not deadlock the workers behind it)."""
+        with self._turn_cv:
+            if self._turn == seq:
+                self._turn = seq + 1
+            self._turn_cv.notify_all()
+
+    @property
+    def assigned(self) -> int:
+        return self._next
+
+    def assign(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray, int]:
+        """Map unique global slot ids → cids, assigning fresh cids to
+        first-seen ids. Returns ``(cids, new_ids, new_base)`` where
+        ``new_ids`` (in assignment order) received cids
+        ``new_base .. new_base+len(new_ids)``.
+        """
+        ids = np.ascontiguousarray(ids, np.int32)
+        with self._lock:
+            pos = np.searchsorted(self._known, ids)
+            found = pos < self._known.shape[0]
+            found[found] = self._known[pos[found]] == ids[found]
+            new_ids = np.sort(ids[~found])
+            n_new = new_ids.shape[0]
+            base = self._next
+            if base + n_new > self.capacity:
+                raise CompactSpaceOverflow(
+                    f"compact space overflow: {base + n_new} distinct "
+                    f"vertices exceed compact_capacity={self.capacity}; "
+                    "raise compact_capacity (it bounds distinct touched "
+                    "vertices per window, not edges)"
+                )
+            if n_new:
+                new_cids = np.arange(base, base + n_new, dtype=np.int32)
+                merged = np.empty(
+                    self._known.shape[0] + n_new, np.int32
+                )
+                merged_cid = np.empty_like(merged)
+                ins = np.searchsorted(self._known, new_ids)
+                # Stable sorted merge: old entries shift right by how many
+                # new ids insert before them.
+                old_pos = (
+                    np.arange(self._known.shape[0])
+                    + np.searchsorted(new_ids, self._known, side="right")
+                )
+                new_pos = ins + np.arange(n_new)
+                merged[old_pos] = self._known
+                merged_cid[old_pos] = self._cid_of
+                merged[new_pos] = new_ids
+                merged_cid[new_pos] = new_cids
+                self._known = merged
+                self._cid_of = merged_cid
+                self._next = base + n_new
+            # Re-probe now that every id is present.
+            pos = np.searchsorted(self._known, ids)
+            return self._cid_of[pos], new_ids, base
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        """cids of already-assigned ids (raises on unknown ids)."""
+        ids = np.ascontiguousarray(ids, np.int32)
+        with self._lock:
+            if self._known.shape[0] == 0:
+                if ids.size:
+                    raise KeyError(
+                        f"{ids.size} ids have no compact assignment "
+                        "(empty session)"
+                    )
+                return np.empty(0, np.int32)
+            pos = np.searchsorted(self._known, ids)
+            bad = (pos >= self._known.shape[0])
+            ok_pos = np.where(bad, 0, pos)
+            bad |= self._known[ok_pos] != ids
+            if bad.any():
+                raise KeyError(
+                    f"{int(bad.sum())} ids have no compact assignment"
+                )
+            return self._cid_of[ok_pos]
+
+    def rebuild_from_vertex_of(self, vertex_of: np.ndarray) -> None:
+        """Restore the session from a checkpointed ``vertex_of`` array
+        (``vertex_of[cid] = global slot id``, -1 for unassigned): the device
+        summary is the durable record of every assignment, so resume needs
+        no separate codec snapshot."""
+        vertex_of = np.asarray(vertex_of)
+        cids = np.nonzero(vertex_of >= 0)[0].astype(np.int32)
+        ids = vertex_of[cids].astype(np.int32)
+        order = np.argsort(ids)
+        with self._lock:
+            self._known = ids[order]
+            self._cid_of = cids[order]
+            # Holes (cids staged but never folded before the crash) stay
+            # dead; allocation resumes past the highest recorded cid.
+            self._next = int(cids.max()) + 1 if cids.size else 0
+
+
+class CompactSpaceOverflow(RuntimeError):
+    """Distinct touched vertices exceeded the session's compact capacity."""

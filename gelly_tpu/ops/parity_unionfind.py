@@ -122,6 +122,15 @@ def union_pairs_parity_compact(f: ParityForest, u: jax.Array, v: jax.Array,
     doubling restores global flatness (depth <= 2 after the root
     updates).
     """
+    if 2 * f.parent.shape[0] >= INT_MAX:
+        # The packed (parent, rel) scatter word is parent * 2 + rel in
+        # int32: beyond 2^30 slots it would overflow (and collide with the
+        # INT_MAX dead-lane sentinel), silently corrupting the forest.
+        raise ValueError(
+            "union_pairs_parity_compact: vertex capacity must be < 2^30 "
+            f"(got {f.parent.shape[0]}; the packed parity scatter word "
+            "is int32)"
+        )
     pu, pv = f.parent[u], f.parent[v]
     link_q = f.rel[u] ^ f.rel[v] ^ q
     roots = jnp.concatenate([pu, pv])

@@ -140,6 +140,155 @@ def union_pairs_compact(parent: jax.Array, src: jax.Array, dst: jax.Array,
     return parent[parent]
 
 
+def _chase_roots(p: jax.Array, x: jax.Array) -> jax.Array:
+    """Pair-sized pointer chase to the TRUE roots of x (exact, while-based)."""
+
+    def cond(st):
+        x_, g = st
+        return jnp.any(g != x_)
+
+    def body(st):
+        x_, g = st
+        return g, p[g]
+
+    x, _ = jax.lax.while_loop(cond, body, (x, p[x]))
+    return x
+
+
+def _rooted_fixpoint(parent: jax.Array, src: jax.Array, rv_fn,
+                     valid: jax.Array, live0) -> jax.Array:
+    """Shared exact hook loop of the pair-sized union kernels: per round,
+    chase ``src`` to true roots, resolve the partner roots with
+    ``rv_fn(p, ru)``, hook root-to-root with one scatter-min; exit when no
+    pair is live. ``live0`` short-circuits the whole loop (a while_loop
+    whose initial predicate is False runs zero iterations).
+
+    Invariants: hooks write ``lo < p[hi] = hi`` at true roots only, so
+    chains stay strictly decreasing (acyclic, ``p[i] <= i``) and every
+    live round strictly lowers some entry (termination). At exit all pairs
+    connect (equal roots) and hooks only ever merge pair-connected trees
+    (no spurious unions).
+    """
+
+    def cond(state):
+        return state[1]
+
+    def body(state):
+        p, _ = state
+        ru = _chase_roots(p, src)
+        rv = rv_fn(p, ru)
+        lo = jnp.minimum(ru, rv)
+        hi = jnp.maximum(ru, rv)
+        live = valid & (lo != hi)
+        p2 = masked_scatter_min(p, hi, lo, live)
+        return p2, jnp.any(live)
+
+    p, _ = jax.lax.while_loop(cond, body, (parent, live0))
+    return p
+
+
+def union_pairs_rooted(parent: jax.Array, src: jax.Array, dst: jax.Array,
+                       valid: jax.Array) -> jax.Array:
+    """Union (src, dst) pairs with ALL per-round work sized to the pairs —
+    the generic exact kernel of the compact-space plans (the hot star-
+    forest fold, :func:`union_pairs_star`, shares its loop via
+    :func:`_rooted_fixpoint` and adds unrolled fast rounds in front).
+
+    Unlike :func:`union_edges` (whose every round walks the full parent
+    array for the doubling step) and :func:`union_pairs_compact` (which
+    re-compacts roots per call with a sort + three binary-search passes,
+    ~5M lookups/s on TPU), each round here:
+
+    1. chases both endpoints' labels to their TRUE roots with a pair-sized
+       pointer chase (inner while_loop of pair-sized gathers);
+    2. hooks root-to-root with one masked scatter-min;
+
+    and exits when every valid pair's roots agree. Invariants: hooks write
+    ``lo < p[hi] = hi`` at true roots only, so chains stay strictly
+    decreasing (acyclic, ``p[i] <= i``) and every live round strictly
+    lowers some entry (termination). At exit all pairs connect (equal
+    roots) and hooks only ever merge pair-connected trees (no spurious
+    unions).
+
+    The forest is returned **without** a global flatten — depth can grow by
+    O(1) per call; later calls chase through it and the window-close
+    transform runs one :func:`pointer_jump` over the full array. That is
+    the point: per-dispatch cost ∝ pairs, full-capacity work once per
+    window (VERDICT r3 item 1).
+    """
+    src = jnp.where(valid, src, 0)
+    dst = jnp.where(valid, dst, 0)
+    return _rooted_fixpoint(
+        parent, src, lambda p, ru: _chase_roots(p, dst), valid,
+        jnp.bool_(True),
+    )
+
+
+def union_pairs_star(parent: jax.Array, v: jax.Array, ri: jax.Array,
+                     valid: jax.Array,
+                     fast_depths: tuple[int, ...] = (2, 3),
+                     check_depth: int = 3) -> jax.Array:
+    """Union star-forest payload rows — the hot compact-codec fold kernel.
+
+    ``(v[j], v[ri[j]])`` are the pairs: every payload row is a host-combined
+    spanning forest whose root is itself a row entry, and the codec ships
+    the root's row INDEX (``ri``), so the root side of each pair resolves
+    with one pair-sized gather from the already-chased array (``rv =
+    ru[ri]``) instead of a second pointer chase.
+
+    Structure (everything sized to the pairs — no O(M) work):
+
+    1. one UNROLLED round per ``fast_depths`` entry: a fixed-depth pointer
+       chase of that many levels (straight-line gathers, no while_loop —
+       measured on v5e, loop iterations cost ~15ms of control overhead
+       each, ~1.8x the 2M-lane gather they wrap) followed by one
+       scatter-min hook MASKED to verified roots (``p[hi] == hi``) — a
+       hook at an interior node would replace a real parent edge and
+       disconnect its ancestors, losing earlier dispatches' unions. Two
+       rounds (depths 2 then 3) measured fully convergent on Zipf
+       payload streams.
+    2. a depth-limited convergence check: equal depth-limited labels imply
+       same tree (chases are deterministic), so ``any(live) == False`` here
+       PROVES convergence and skips step 3 entirely (a while_loop whose
+       initial predicate is False runs zero iterations).
+    3. an exact fixpoint fallback (true-root chase per round, shared with
+       :func:`union_pairs_rooted`) for whatever the fast pass leaves
+       unresolved — short chases, hook conflicts, root-mask rejections.
+       Correctness never depends on the unrolled depth.
+
+    Like :func:`union_pairs_rooted`, the forest is returned without a
+    global flatten; the window-close transform pays the one full-array
+    pointer_jump.
+    """
+    v = jnp.where(valid, v, 0)
+
+    def chase_fixed(p, x, depth):
+        g = p[x]
+        for _ in range(depth - 1):
+            g = p[g]
+        return g
+
+    p = parent
+    for depth in fast_depths:
+        ru = chase_fixed(p, v, depth)
+        rv = ru[ri]
+        lo = jnp.minimum(ru, rv)
+        hi = jnp.maximum(ru, rv)
+        # Hook ONLY at verified roots: a depth-limited chase can stop at
+        # an interior node, and a scatter-min there would REPLACE its real
+        # parent edge — disconnecting its ancestor chain and silently
+        # splitting a component built by earlier dispatches (a root's
+        # self-loop is the only edge safe to overwrite). Pairs whose
+        # chase fell short stay live for the check below and resolve in
+        # the exact fixpoint.
+        live = valid & (lo != hi) & (p[hi] == hi)
+        p = masked_scatter_min(p, hi, lo, live)
+
+    ru = chase_fixed(p, v, check_depth)
+    live0 = jnp.any(valid & (ru != ru[ri]))
+    return _rooted_fixpoint(p, v, lambda p_, ru_: ru_[ri], valid, live0)
+
+
 def merge_forests(a: jax.Array, b: jax.Array) -> jax.Array:
     """Union two forests over the same slot space (DisjointSet.merge :127-131)."""
     idx = jnp.arange(a.shape[0], dtype=jnp.int32)

@@ -129,6 +129,15 @@ def _load_combiner() -> ctypes.CDLL:
             lib._has_sparse_codecs = True
         except AttributeError:
             lib._has_sparse_codecs = False
+        try:
+            lib.cc_chunk_combine_sparse_idx.restype = ctypes.c_int64
+            lib.cc_chunk_combine_sparse_idx.argtypes = [
+                _i32p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int32,
+                _i32p, _i32p, _i32p, ctypes.c_int64,
+            ]
+            lib._has_sparse_idx = True
+        except AttributeError:
+            lib._has_sparse_idx = False
         lib._sigs_set = True
     return lib
 
@@ -401,6 +410,39 @@ def cc_chunk_combine_sparse(src: np.ndarray, dst: np.ndarray,
     )
     _sparse_rc_check(rc, "cc_chunk_combine_sparse")
     return out_v[:rc], out_r[:rc]
+
+
+def sparse_idx_available() -> bool:
+    """The combiner exports the root-indexed sparse codec."""
+    return available("chunk_combiner") and getattr(
+        _load_combiner(), "_has_sparse_idx", False
+    )
+
+
+def cc_chunk_combine_sparse_idx(src: np.ndarray, dst: np.ndarray,
+                                valid: np.ndarray | None, n_v: int):
+    """Counted (vertex, root, root-index) triples of one chunk's spanning
+    forest — the compact-codec wire format. ``roots[ri[j]] == roots[j]``'s
+    vertex, i.e. ``verts[ri[j]] == roots[j]``: the device fold resolves a
+    pair's root side by indexing its own chased array instead of a second
+    pointer chase. GIL released during the call."""
+    lib = _load_combiner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    cap = 2 * max(1, src.shape[0])
+    out_v = np.empty((cap,), np.int32)
+    out_r = np.empty((cap,), np.int32)
+    out_ri = np.empty((cap,), np.int32)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    rc = lib.cc_chunk_combine_sparse_idx(
+        _as_i32p(src), _as_i32p(dst), vp, src.shape[0], n_v,
+        _as_i32p(out_v), _as_i32p(out_r), _as_i32p(out_ri), cap,
+    )
+    _sparse_rc_check(rc, "cc_chunk_combine_sparse_idx")
+    return out_v[:rc], out_r[:rc], out_ri[:rc]
 
 
 def parity_chunk_combine_sparse(src: np.ndarray, dst: np.ndarray,

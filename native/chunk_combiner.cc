@@ -282,6 +282,46 @@ int64_t cc_chunk_combine_sparse(const int32_t* src, const int32_t* dst,
   return t.count;
 }
 
+// Root-indexed variant for the compact-space codec: identical to
+// cc_chunk_combine_sparse, except the root is ALSO reported as its output
+// position (out_ri[j] = index k with out_v[k] == root of out_v[j]). The
+// root's local id doubles as its output slot here, so the index costs
+// nothing extra — and it saves the device fold a whole pointer chase per
+// pair (rv = chased_roots[ri] instead of re-chasing the root id).
+int64_t cc_chunk_combine_sparse_idx(const int32_t* src, const int32_t* dst,
+                                    const uint8_t* valid, int64_t n,
+                                    int32_t n_v, int32_t* out_v,
+                                    int32_t* out_r, int32_t* out_ri,
+                                    int64_t cap_pairs) {
+  LocalTable t;
+  if (!t.init(n)) return -4;
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) continue;
+    const int32_t u = src[i];
+    const int32_t v = dst[i];
+    if (u < 0 || u >= n_v || v < 0 || v >= n_v) return -2;
+    const int32_t lu = t.intern(u);
+    const int32_t lv = t.intern(v);
+    const int32_t ru = find_root(t.parent, lu);
+    const int32_t rv = find_root(t.parent, lv);
+    if (ru != rv) {
+      if (t.vert[ru] < t.vert[rv]) {
+        t.parent[rv] = ru;
+      } else {
+        t.parent[ru] = rv;
+      }
+    }
+  }
+  if (t.count > cap_pairs) return -3;
+  for (int32_t j = 0; j < t.count; ++j) {
+    const int32_t r = find_root(t.parent, j);
+    out_v[j] = t.vert[j];
+    out_r[j] = t.vert[r];
+    out_ri[j] = r;
+  }
+  return t.count;
+}
+
 // Sparse parity (bipartiteness) codec: (vertex, root, parity) triples plus
 // a chunk-local odd-cycle flag. Same contract as cc_chunk_combine_sparse
 // with out_p[j] = 2-coloring parity of out_v[j] relative to out_r[j].

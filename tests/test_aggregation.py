@@ -248,6 +248,42 @@ def test_allowed_lateness_reorders_within_bound():
     assert snap_bad.stats["late_edges"] >= 1
 
 
+def test_lateness_buffer_stats_exposed():
+    # ADVICE r3: the reorder buffer's live footprint is observable —
+    # buffered_edges returns to 0 after the final flush and open_windows
+    # stays within the lateness/window bound while iterating.
+    from gelly_tpu.core.chunk import make_chunk
+    from gelly_tpu.core.windows import tumbling_window_events
+
+    rng = np.random.default_rng(5)
+    n = 256
+    ts = np.sort(rng.integers(0, 4000, n)).astype(np.int64)
+    chunks = [
+        make_chunk(
+            np.arange(32, dtype=np.int64), np.arange(32, dtype=np.int64),
+            ts=ts[lo:lo + 32], capacity=32, device=False,
+        )
+        for lo in range(0, n, 32)
+    ]
+    stats: dict = {}
+    seen_buffered = 0
+    seen_open = 0
+    # Bound: (lateness + max chunk ts span) / window_ms + 1 open windows.
+    span = max(int(ts[lo:lo + 32].max() - ts[lo:lo + 32].min())
+               for lo in range(0, n, 32))
+    bound = -(-(500 + span) // 250) + 1
+    for kind, w, c, k in tumbling_window_events(
+        iter(chunks), 250, stats, allowed_lateness=500
+    ):
+        seen_buffered = max(seen_buffered, stats["buffered_edges"])
+        seen_open = max(seen_open, stats["open_windows"])
+        assert stats["open_windows"] <= bound
+    assert seen_buffered > 0 and seen_open > 0
+    # Fully drained after the final flush.
+    assert stats["buffered_edges"] == 0
+    assert stats["open_windows"] == 0
+
+
 def test_allowed_lateness_engine_window_mode():
     # Engine window_ms path with lateness: CC labels equal the sorted run.
     from gelly_tpu.core.io import EdgeChunkSource, TimeCharacteristic

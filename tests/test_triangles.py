@@ -371,6 +371,41 @@ def test_window_triangles_sparse_overflow_raises():
         list(window_triangle_counts_batched(s, 1000, max_degree=4))
 
 
+def test_window_triangles_sparse_yield_overflow():
+    from gelly_tpu.library.triangles import window_triangle_counts_batched
+
+    # yield_overflow=True surfaces the per-window overflow scalar so
+    # per-yield consumers can gate programmatically (ADVICE r3): clean
+    # windows report 0, an overflowing window reports its dropped-entry
+    # count in the SAME yielded tuple (before the deferred raise fires).
+    edges = [(0, 1, 1.0), (1, 2, 1.0), (0, 2, 1.0)]  # one clean triangle
+    s = edge_stream_from_edges(
+        edges, vertex_capacity=64, chunk_size=32,
+        time=TimeCharacteristic.EVENT,
+        timestamps=np.zeros(len(edges), dtype=np.int64),
+    )
+    out = list(window_triangle_counts_batched(
+        s, 1000, max_degree=4, yield_overflow=True
+    ))
+    assert len(out) == 1
+    w, count, over = out[0]
+    assert int(count) == 1 and int(over) == 0
+
+    star = [(0, i, 1.0) for i in range(1, 20)]  # degree 19 > max_degree 4
+    s = edge_stream_from_edges(
+        star, vertex_capacity=64, chunk_size=32,
+        time=TimeCharacteristic.EVENT,
+        timestamps=np.zeros(len(star), dtype=np.int64),
+    )
+    it = window_triangle_counts_batched(
+        s, 1000, max_degree=4, yield_overflow=True
+    )
+    w, count, over = next(it)
+    assert int(over) > 0  # corrupt window flagged in-band
+    with pytest.raises(ValueError, match="max_degree"):
+        list(it)  # the deferred guard still fires
+
+
 def test_window_triangles_sparse_million_vertex_capacity():
     # The whole point of the sparse kernel: vertex capacity where the
     # dense bool[N, N] adjacency (and the packed i32 format) cannot exist.

@@ -159,3 +159,101 @@ def test_union_pairs_parity_compact_matches_union_edges_parity():
         np.testing.assert_array_equal(p, p[p])
         r = np.asarray(f_b.rel)
         assert (r[p == np.arange(n)] == 0).all()
+
+
+# ---------------- pair-sized kernels (compact-space folds) -------------- #
+
+
+def _pair_oracle(m, all_pairs):
+    parent = list(range(m))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in all_pairs:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+    return [find(x) for x in range(m)]
+
+
+def test_union_pairs_rooted_matches_union_edges():
+    from gelly_tpu.ops.unionfind import union_pairs_rooted
+
+    rng = np.random.default_rng(3)
+    m = 64
+    p = fresh_forest(m)
+    all_pairs = []
+    for _ in range(5):  # sequential calls over one never-flattened forest
+        src = rng.integers(0, m, 20).astype(np.int32)
+        dst = rng.integers(0, m, 20).astype(np.int32)
+        ok = rng.random(20) < 0.8
+        all_pairs += [(int(a), int(b))
+                      for a, b, o in zip(src, dst, ok) if o]
+        p = union_pairs_rooted(p, jnp.asarray(src), jnp.asarray(dst),
+                               jnp.asarray(ok))
+    assert labels_of(p, m) == _pair_oracle(m, all_pairs)
+
+
+def test_union_pairs_star_deep_chain_no_severed_edges():
+    # Deterministic regression for the severed-edge bug (code-review r4):
+    # build croot chain 20->19->18->17->16 over five calls, then union
+    # (20, 3). The depth-2 fast chase stops at INTERIOR node 18; an
+    # unmasked hook would overwrite p[18]=17 with 3, disconnecting
+    # {17, 16} — and the depth-3 convergence check then reads (20, 3) as
+    # satisfied, so the exact fallback never repairs the split. The root
+    # mask must reject that hook and route the pair to the exact loop.
+    from gelly_tpu.ops.unionfind import union_pairs_star
+
+    p = fresh_forest(24)
+    rows = [(20, 19), (19, 18), (18, 17), (17, 16), (20, 3)]
+    for a, root in rows:
+        v = jnp.array([root, a], jnp.int32)
+        ri = jnp.array([0, 0], jnp.int32)
+        p = union_pairs_star(p, v, ri, jnp.ones(2, bool))
+    lab = labels_of(p, 24)
+    assert len({lab[x] for x in (3, 16, 17, 18, 19, 20)}) == 1, lab
+
+
+def test_union_pairs_star_sequential_calls_fuzz():
+    # Regression for the severed-edge bug (code-review r4): unrolled fast
+    # rounds hooking at a depth-limited NON-root overwrote its real parent
+    # edge, disconnecting ancestors and silently splitting components
+    # built by earlier dispatches. Adversarial star payloads over many
+    # sequential calls on one never-flattened forest, vs a pair oracle.
+    from gelly_tpu.ops.unionfind import union_pairs_star
+
+    for seed in range(8):
+        rng = np.random.default_rng(seed)
+        m = 24
+        p = fresh_forest(m)
+        all_pairs = []
+        for _ in range(6):
+            # One star-forest row: unique v, row-local root indices ri.
+            n_row = int(rng.integers(2, m))
+            v = rng.permutation(m)[:n_row].astype(np.int32)
+            # Random forest over the row: each entry points at a random
+            # earlier entry (or itself) -> ri is a valid root index map.
+            parent_idx = np.arange(n_row)
+            for j in range(1, n_row):
+                if rng.random() < 0.7:
+                    parent_idx[j] = int(rng.integers(0, j))
+            # Path-compress to row roots.
+            for j in range(n_row):
+                r = j
+                while parent_idx[r] != r:
+                    r = parent_idx[r]
+                parent_idx[j] = r
+            ri = parent_idx.astype(np.int32)
+            all_pairs += [(int(v[j]), int(v[ri[j]]))
+                          for j in range(n_row)]
+            p = union_pairs_star(
+                p, jnp.asarray(v), jnp.asarray(ri),
+                jnp.ones(n_row, bool),
+            )
+        got = labels_of(p, m)
+        want = _pair_oracle(m, all_pairs)
+        assert got == want, (seed, got, want)
