@@ -1098,6 +1098,107 @@ def bench_cc_large(args) -> dict:
     }
 
 
+_SHARDED_STATE_CHILD = r"""
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+from gelly_tpu.parallel import mesh as mesh_lib
+from gelly_tpu.parallel.sharded_cc import ShardedCC
+from gelly_tpu.ops.unionfind import merge_forest_stack
+
+S = 8
+m = mesh_lib.make_mesh(S)
+rng = np.random.default_rng(11)
+n_pairs = 1 << 16
+out = {}
+for n_v in (1 << 20, 1 << 23):
+    a = (rng.zipf(1.4, n_pairs) % n_v).astype(np.int32)
+    b = (rng.zipf(1.4, n_pairs) % n_v).astype(np.int32)
+    # Slot-sharded plan: state maintenance = the pair fold itself (there
+    # is no separate per-window cross-shard merge — folds keep the global
+    # forest consistent through the keyed exchange).
+    cc = ShardedCC(n_v, mesh=m)
+    cc.fold(a, b)  # compile
+    dt_s = float("inf")
+    for _ in range(2):
+        cc2 = ShardedCC(n_v, mesh=m)
+        t0 = time.perf_counter()
+        cc2.fold(a, b)
+        dt_s = min(dt_s, time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    cc2.labels()  # emission: host flatten + decode, inherently prop. n
+    dt_emit = time.perf_counter() - t0
+    # Replicated plan's per-window merge: stacked S x n_v forest union
+    # (cost inherently prop. to full capacity, pairs or not).
+    stack = jnp.broadcast_to(jnp.arange(n_v, dtype=jnp.int32)[None], (S, n_v))
+    merged = merge_forest_stack(stack); np.asarray(merged)  # compile
+    dt_r = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        np.asarray(merge_forest_stack(stack))
+        dt_r = min(dt_r, time.perf_counter() - t0)
+    out[str(n_v)] = {
+        "sharded_fold_s": round(dt_s, 3),
+        "emission_s": round(dt_emit, 3),
+        "replicated_merge_s": round(dt_r, 3),
+        "per_device_state_bytes": cc.per_device_state_bytes(),
+        "replicated_state_bytes": n_v * 5,
+    }
+print(json.dumps(out))
+"""
+
+
+def bench_sharded_state() -> dict:
+    """Slot-sharded CC summaries (VERDICT r3 item 2): the vertex-striped
+    plan has NO per-window cross-shard merge — state maintenance is the
+    pair fold (∝ pairs), vs the replicated plan's stacked merge (∝ n_v by
+    construction); emission (∝ output size, inherent) is reported
+    separately. Runs on an 8-virtual-device CPU mesh in a clean child
+    (this process owns the single-chip TPU backend); absolute CPU times
+    are not comparable to the TPU lines — only the capacity SLOPE is the
+    claim. Per-device state is n_v/S (asserted in
+    tests/test_sharded_cc.py and the driver dryrun).
+    """
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    kept = " ".join(
+        t for t in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in t
+    )
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"{kept} --xla_force_host_platform_device_count=8".strip(),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-I", "-c",
+         f"import sys; sys.path.insert(0, {here!r})\n" + _SHARDED_STATE_CHILD],
+        env=env, cwd=here, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        return {"metric": "sharded_state_cc", "error": proc.stderr[-400:]}
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    lo, hi = rows["1048576"], rows["8388608"]
+    return {
+        "metric": "sharded_state_cc",
+        # Headline: 8x the capacity costs the sharded fold ~1x (pairs
+        # fixed), while the replicated per-window merge pays the full 8x.
+        "value": round(
+            hi["sharded_fold_s"] / max(lo["sharded_fold_s"], 1e-9), 2
+        ),
+        "unit": "x fold cost for 8x capacity (8-dev CPU mesh; 1.0 = flat)",
+        "capacity_slope_replicated_merge": round(
+            hi["replicated_merge_s"] / max(lo["replicated_merge_s"], 1e-9), 2,
+        ),
+        "detail": rows,
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--workload", default="all",
@@ -1174,6 +1275,7 @@ def main() -> int:
         except SystemExit as e:
             print(json.dumps({"metric": name, "error": str(e)}))
     print(json.dumps(bench_cc(args)))
+    print(json.dumps(bench_sharded_state()))
     print(json.dumps(bench_cc_large(args)))
     return 0
 

@@ -455,7 +455,7 @@ def run_aggregation(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 1,
     resume: bool = False,
-    prefetch_depth: int = 2,
+    prefetch_depth: int | None = None,
     device_fields: tuple[str, ...] | None = None,
     host_precombine: Callable | None = None,
     fold_batch: int = 1,
@@ -470,7 +470,11 @@ def run_aggregation(
     the closest analog of the reference's per-window emission).
 
     ``prefetch_depth`` chunks of host ingest (parse/densify/H2D) overlap
-    device folds on a background thread; 0 disables.
+    device folds on a background thread; 0 disables. Default (None) is
+    ``max(2, ingest_workers)`` so the worker pool stays fed; an EXPLICIT
+    value is honored exactly — it is the caller's bound on in-flight
+    staged units (host/device memory ∝ depth × unit size), and capping it
+    below the worker count deliberately idles workers for memory.
 
     ``device_fields`` names chunk fields to device_put on the prefetch
     thread (e.g. ``("src", "dst", "valid")`` for CC): the H2D of exactly
@@ -541,6 +545,8 @@ def run_aggregation(
         # (two workers there evict each other's tens-of-MB working sets
         # and run ~2-4x slower than one).
         ingest_workers = available_cores()
+    if prefetch_depth is None:
+        prefetch_depth = max(2, ingest_workers)
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)
     plan = _compiled_plan(agg, m)
@@ -865,14 +871,8 @@ def run_aggregation(
                 fold_unit = fold_step
             from ..utils.prefetch import prefetch_map
 
-            # Lookahead must cover the worker pool: with depth <
-            # workers, the submitter blocks on the result queue after
-            # ~depth outstanding units and the extra workers idle (host
-            # memory per in-flight unit is the trade documented on
-            # prefetch_depth).
             for unit, k in prefetch_map(
-                stage_unit, produced_units(),
-                depth=max(prefetch_depth, ingest_workers),
+                stage_unit, produced_units(), depth=prefetch_depth,
                 workers=ingest_workers,
             ):
                 chunks_consumed += k

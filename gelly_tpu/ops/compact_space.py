@@ -61,6 +61,7 @@ class CompactIdSession:
             self._next = 0
         with self._turn_cv:
             self._turn = 0
+            self._released = set()
             self._turn_cv.notify_all()
 
     def await_turn(self, seq: int) -> None:
@@ -78,10 +79,17 @@ class CompactIdSession:
 
     def complete_turn(self, seq: int) -> None:
         """Mark unit ``seq``'s assignment done (call in a finally: a
-        failed unit must not deadlock the workers behind it)."""
+        failed unit must not deadlock the workers behind it).
+
+        Out-of-order releases are REMEMBERED: a unit that fails before its
+        turn comes up releases early, and the turn counter skips past it
+        once the units ahead of it finish — without this, the release
+        would be discarded and every later unit would park forever."""
         with self._turn_cv:
-            if self._turn == seq:
-                self._turn = seq + 1
+            self._released.add(seq)
+            while self._turn in self._released:
+                self._released.discard(self._turn)
+                self._turn += 1
             self._turn_cv.notify_all()
 
     @property

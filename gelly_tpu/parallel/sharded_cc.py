@@ -1,0 +1,309 @@
+"""Slot-sharded Connected Components — vertex-partitioned summary state.
+
+Every other CC plan replicates the full ``parent[vertex_capacity]`` summary
+on each shard (the mesh shards only the *edge* axis), so per-device memory
+and per-window merge cost stay ∝ capacity. This module shards the SUMMARY
+itself: device ``d`` of an S-shard mesh owns the striped vertex slots
+``{g : g % S == d}`` (``partition.owner_of``) and holds only
+
+  ``parent_loc: i32[capacity / S]``  — global parent pointer per owned slot
+  ``seen_loc:   bool[capacity / S]`` — owned slots observed in the stream
+
+This is the reference's actual state layout: Flink's ``keyBy(0)`` gives
+each subtask ownership of a vertex partition's state
+(``M/SimpleEdgeStream.java:157-158``, ``M/SummaryBulkAggregation.java:78``);
+the replicated plans were the ``timeWindowAll`` fan-in view. Routing is the
+keyed exchange (:func:`~gelly_tpu.parallel.partition.repartition_by_key`,
+all_to_all over ICI), with static bucket capacities and COUNTED overflow.
+
+Algorithm (per fold of a pair batch, inside one ``shard_map`` program):
+
+1. distributed pointer chase: both endpoints' labels resolve to TRUE roots
+   by iterated owner lookups (each level = one request + one response
+   all_to_all, work ∝ pairs);
+2. root-to-root hook: (hi, lo) routed to hi's owner, applied as a
+   scatter-min MASKED to self-roots (add-only: a prior dispatch's edge is
+   never overwritten — the severed-edge hazard the star fold's review
+   found);
+3. repeat while any pair is live (``psum``-reduced flag). Chased roots are
+   true roots, so every live round applies a hook and strictly lowers an
+   entry — no livelock.
+
+There is NO per-window cross-shard merge in this plan — that is the point.
+Folds keep the global forest consistent incrementally at pair cost (the
+replicated plans pay a full-capacity stacked union per window close,
+``merge_forest_stack``). The only full-capacity work is EMISSION
+(``labels()``): materializing an i32[capacity] label array is inherently ∝
+capacity, so the flatten runs on the host over the pulled stripes
+(vectorized pointer jumping), and the flattened parent is pushed back so
+subsequent folds chase depth-1 state. Labels come back striped;
+:func:`~gelly_tpu.parallel.partition.unstripe` restores global slot order.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.segments import INT_MAX
+from . import mesh as mesh_lib
+from .mesh import SHARD_AXIS
+from .partition import (
+    owner_of,
+    repartition_by_key,
+    slots_per_shard,
+    to_local_slot,
+    unstripe,
+)
+
+
+def _exchange_back(x: jax.Array, num_shards: int) -> jax.Array:
+    """Reverse leg of a request/response pair: segment s of a
+    repartitioned [S*cap] buffer came FROM shard s, so one more
+    all_to_all returns each segment to its requester."""
+    cap = x.shape[0] // num_shards
+    y = jax.lax.all_to_all(
+        x.reshape((num_shards, cap) + x.shape[1:]),
+        SHARD_AXIS, split_axis=0, concat_axis=0,
+    )
+    return y.reshape(x.shape)
+
+
+def sharded_lookup(state_loc: jax.Array, slots: jax.Array,
+                   valid: jax.Array, num_shards: int,
+                   bucket_capacity: int):
+    """value-of-global-slot over the sharded state: route queries to the
+    owners (keyed exchange), gather locally, route responses back.
+
+    Returns ``(values[L], answered[L], dropped)`` — ``answered`` is False
+    where the query was invalid or overflowed a bucket (counted in the
+    psum'd ``dropped``); such lanes keep value 0 and the caller retries
+    next round (drops here cost rounds, never correctness).
+    """
+    L = slots.shape[0]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    k, home_idx, ok, dropped = repartition_by_key(
+        slots, idx, valid, num_shards, bucket_capacity
+    )
+    vals = jnp.where(ok, state_loc[to_local_slot(k, num_shards)], 0)
+    vals_h = _exchange_back(vals, num_shards)
+    idx_h = _exchange_back(home_idx, num_shards)
+    ok_h = _exchange_back(ok, num_shards)
+    out = jnp.zeros((L,), state_loc.dtype).at[
+        jnp.where(ok_h, idx_h, L)
+    ].set(vals_h, mode="drop")
+    answered = jnp.zeros((L,), bool).at[
+        jnp.where(ok_h, idx_h, L)
+    ].set(True, mode="drop")
+    return out, answered, dropped
+
+
+def _chase_sharded(parent_loc, x, valid, num_shards, bucket_capacity):
+    """Distributed pointer chase of global slots ``x`` to TRUE roots.
+
+    Each level is one sharded_lookup (pair-sized). Terminates: the forest
+    is acyclic with strictly decreasing chains. An unanswered (overflowed)
+    lookup leaves that lane at its current label — callers treat such
+    lanes as unresolved this round.
+    """
+
+    def cond(st):
+        return st[2]
+
+    def body(st):
+        x_, settled, _, drops = st
+        nxt, answered, d = sharded_lookup(
+            parent_loc, x_, valid & ~settled, num_shards, bucket_capacity
+        )
+        moved = answered & (nxt != x_)
+        x2 = jnp.where(moved, nxt, x_)
+        # A slot whose lookup answered with itself is a root; an
+        # unanswered (dropped) lane stays pending and retries next level.
+        settled2 = settled | (answered & (nxt == x_))
+        pending_any = jax.lax.psum(
+            jnp.sum(valid & ~settled2), SHARD_AXIS
+        ) > 0
+        return x2, settled2, pending_any, drops + d
+
+    pending0 = jax.lax.psum(jnp.sum(valid), SHARD_AXIS) > 0
+    x, _, _, drops = jax.lax.while_loop(
+        cond, body, (x, ~valid, pending0, jnp.int64(0))
+    )
+    return x, drops
+
+
+def _fold_pairs_body(parent_loc, seen_loc, a, b, ok, num_shards,
+                     bucket_capacity):
+    """One shard's view of the pair fold (runs inside shard_map)."""
+    per = parent_loc.shape[0]
+
+    # Mark seen: route each endpoint to its owner once.
+    for endpoint in (a, b):
+        k, _, got, _ = repartition_by_key(
+            endpoint, jnp.zeros_like(endpoint), ok, num_shards,
+            bucket_capacity,
+        )
+        seen_loc = seen_loc.at[
+            jnp.where(got, to_local_slot(k, num_shards), per)
+        ].set(True, mode="drop")
+
+    def cond(st):
+        _, live_any, _ = st
+        return live_any
+
+    def body(st):
+        p_loc, _, drops = st
+        ra, d1 = _chase_sharded(p_loc, a, ok, num_shards, bucket_capacity)
+        rb, d2 = _chase_sharded(p_loc, b, ok, num_shards, bucket_capacity)
+        lo = jnp.minimum(ra, rb)
+        hi = jnp.maximum(ra, rb)
+        live = ok & (lo != hi)
+        # Hook root-to-root at hi's owner, masked to self-roots (add-only:
+        # never overwrite a real parent edge from an earlier dispatch).
+        k, lo_r, got, d3 = repartition_by_key(
+            hi, lo, live, num_shards, bucket_capacity
+        )
+        loc = jnp.where(got, to_local_slot(k, num_shards), per)
+        upd = jnp.full((per + 1,), INT_MAX, jnp.int32).at[loc].min(
+            jnp.where(got, lo_r, INT_MAX)
+        )[:per]
+        is_root = p_loc == (
+            jnp.arange(per, dtype=jnp.int32) * num_shards
+            + jax.lax.axis_index(SHARD_AXIS)
+        )
+        p2 = jnp.where(is_root, jnp.minimum(p_loc, upd), p_loc)
+        live_any = jax.lax.psum(jnp.sum(live), SHARD_AXIS) > 0
+        return p2, live_any, drops + d1 + d2 + d3
+
+    parent_loc, _, drops = jax.lax.while_loop(
+        cond, body, (parent_loc, jnp.bool_(True), jnp.int64(0))
+    )
+    return parent_loc, seen_loc, drops
+
+
+class ShardedCC:
+    """Vertex-striped CC summary over a mesh — state ∝ capacity/S per
+    device. ``fold(a, b, valid)`` unions a global-id pair batch;
+    ``labels()`` flattens and returns the full i32[capacity] label array
+    (canonical min slot, -1 unseen — identical to every other CC plan).
+    ``stats['dropped']`` counts exchange-bucket overflows — always 0 with
+    the built-in worst-case buckets; kept as an invariant check.
+    """
+
+    def __init__(self, vertex_capacity: int, mesh=None):
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        self.S = mesh_lib.num_shards(self.mesh)
+        self.n = vertex_capacity
+        self.per = slots_per_shard(vertex_capacity, self.S)
+        self.stats = {"dropped": 0}
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharded = NamedSharding(self.mesh, P(SHARD_AXIS))
+        S, per = self.S, self.per
+
+        # Striped init: device d's local slot j is global slot j*S + d.
+        @partial(jax.jit, out_shardings=(sharded, sharded))
+        def init():
+            def body():
+                me = jax.lax.axis_index(SHARD_AXIS)
+                g = jnp.arange(per, dtype=jnp.int32) * S + me
+                return g[None], jnp.zeros((1, per), bool)
+
+            return mesh_lib.shard_map_fn(
+                self.mesh, body, in_specs=(), out_specs=(P(SHARD_AXIS),) * 2,
+            )()
+
+        self.parent, self.seen = init()
+        self._fold_fn = None
+
+    def _bucket(self, L: int) -> int:
+        # Worst case ALL of a device's L entries route to one owner: L
+        # keeps the exchange DROP-FREE (transient buffers S*L). This is
+        # deliberately not a knob — a bucket smaller than a hot owner's
+        # routed-lane count would drop the same lanes every retry round
+        # and livelock the chase/hook while_loops (deterministic packing).
+        return L
+
+    def fold(self, a: np.ndarray, b: np.ndarray,
+             valid: np.ndarray | None = None) -> None:
+        """Union a batch of global-id pairs (host arrays, padded evenly
+        across shards here)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        a = np.asarray(a, np.int32)
+        b = np.asarray(b, np.int32)
+        ok = (np.ones(a.shape, bool) if valid is None
+              else np.asarray(valid, bool))
+        S = self.S
+        L = -(-a.shape[0] // S)
+        pad = L * S - a.shape[0]
+        if pad:
+            a = np.concatenate([a, np.zeros(pad, np.int32)])
+            b = np.concatenate([b, np.zeros(pad, np.int32)])
+            ok = np.concatenate([ok, np.zeros(pad, bool)])
+        sharded = NamedSharding(self.mesh, P(SHARD_AXIS))
+        av = jax.device_put(a.reshape(S, L), sharded)
+        bv = jax.device_put(b.reshape(S, L), sharded)
+        okv = jax.device_put(ok.reshape(S, L), sharded)
+
+        cap = self._bucket(L)
+        key = (L, cap)
+        if self._fold_fn is None or self._fold_fn[0] != key:
+            from jax.sharding import PartitionSpec as P2
+
+            @partial(jax.jit, out_shardings=(sharded, sharded, None))
+            def fold_fn(parent, seen, a_, b_, ok_):
+                def body(p, s, aa, bb, oo):
+                    p2, s2, drops = _fold_pairs_body(
+                        p[0], s[0], aa[0], bb[0], oo[0], S, cap
+                    )
+                    return p2[None], s2[None], drops
+
+                p2, s2, drops = mesh_lib.shard_map_fn(
+                    self.mesh, body,
+                    in_specs=(P2(SHARD_AXIS),) * 5,
+                    out_specs=(P2(SHARD_AXIS), P2(SHARD_AXIS), P2()),
+                )(parent, seen, a_, b_, ok_)
+                return p2, s2, jnp.sum(drops)
+
+            self._fold_fn = (key, fold_fn)
+        self.parent, self.seen, drops = self._fold_fn[1](
+            self.parent, self.seen, av, bv, okv
+        )
+        self.stats["dropped"] += int(drops)
+
+    def labels(self) -> np.ndarray:
+        """Emit global labels i32[capacity] (the window close).
+
+        Emission is inherently ∝ capacity (the output array is), so the
+        flatten runs on the HOST over the pulled stripes — vectorized
+        pointer jumping in global slot space — and the flattened parent is
+        pushed back so later folds chase depth-1 state. Fold/merge cost
+        stays ∝ pairs; only this emission pass touches full capacity
+        (the same once-per-window contract as the compact plan's
+        transform).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        S = self.S
+        flat = unstripe(np.asarray(self.parent).reshape(-1), S)
+        while True:
+            nxt = flat[flat]
+            if np.array_equal(nxt, flat):
+                break
+            flat = nxt
+        seen = unstripe(np.asarray(self.seen).reshape(-1), S)
+        # Push the flattened forest back (re-stripe): keeps device-side
+        # chase depth at 1 for the next window's folds.
+        restriped = flat.reshape(self.per, S).T.copy()
+        self.parent = jax.device_put(
+            restriped, NamedSharding(self.mesh, P(SHARD_AXIS))
+        )
+        return np.where(seen, flat, -1).astype(np.int32)
+
+    def per_device_state_bytes(self) -> int:
+        return self.per * 4 + self.per  # parent i32 + seen bool

@@ -99,6 +99,32 @@ def test_session_turn_ordering():
     assert np.array_equal(s.lookup(np.array([7, 9, 8])), [0, 1, 2])
 
 
+def test_session_turn_release_before_turn_unparks_later_units():
+    # A unit that fails BEFORE its turn releases out of order; the release
+    # must be remembered (not discarded) so the turn counter skips the
+    # dead unit once earlier units finish — otherwise later units park
+    # forever (code-review r4 follow-up).
+    import threading
+
+    s = CompactIdSession(64)
+    s.complete_turn(2)  # unit 2 died early, _turn still 0
+    done = []
+
+    def unit3():
+        s.await_turn(3)
+        done.append(3)
+        s.complete_turn(3)
+
+    t3 = threading.Thread(target=unit3)
+    t3.start()
+    for seq in (0, 1):
+        s.await_turn(seq)
+        s.complete_turn(seq)
+    t3.join(5)
+    assert done == [3]  # unit 3 unparked through the dead unit's slot
+    assert not t3.is_alive()
+
+
 def test_compact_parity_with_two_ingest_workers():
     src, dst = _rand_edges(n_e=5000, seed=29)
     oracle = cc_labels_numpy(src.astype(np.int32), dst.astype(np.int32),

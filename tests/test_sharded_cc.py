@@ -1,0 +1,94 @@
+"""Slot-sharded CC summaries (VERDICT r3 item 2): the summary state itself
+is vertex-striped across the mesh — per-device memory capacity/S — with
+pair routing over the keyed exchange and a bounded hook/flatten loop.
+
+Parity oracle: the replicated plans' label semantics (canonical min slot,
+-1 unseen), asserted exactly against cc_labels_numpy on the 8-virtual-
+device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_tpu.library.connected_components import cc_labels_numpy
+from gelly_tpu.parallel import mesh as mesh_lib
+from gelly_tpu.parallel.sharded_cc import ShardedCC
+
+N_V = 512
+
+
+def _pairs(n_e, seed, n_v=N_V):
+    rng = np.random.default_rng(seed)
+    a = (rng.zipf(1.4, n_e) % n_v).astype(np.int32)
+    b = (rng.zipf(1.4, n_e) % n_v).astype(np.int32)
+    return a, b
+
+
+def test_sharded_cc_parity_single_fold():
+    a, b = _pairs(600, seed=1)
+    cc = ShardedCC(N_V)  # all 8 virtual devices
+    cc.fold(a, b)
+    labels = cc.labels()
+    oracle = cc_labels_numpy(a, b, None, N_V)
+    assert np.array_equal(labels, oracle)
+    assert cc.stats["dropped"] == 0
+
+
+def test_sharded_cc_parity_many_folds():
+    # Sequential dispatches over one sharded forest (the streaming shape):
+    # intermediate labels() calls flatten mid-stream and folding must
+    # continue correctly afterwards.
+    cc = ShardedCC(N_V)
+    alla, allb = [], []
+    for i, seed in enumerate([3, 4, 5, 6]):
+        a, b = _pairs(300, seed=seed)
+        alla.append(a)
+        allb.append(b)
+        cc.fold(a, b)
+        if i == 1:
+            mid = cc.labels()
+            mid_oracle = cc_labels_numpy(
+                np.concatenate(alla), np.concatenate(allb), None, N_V
+            )
+            assert np.array_equal(mid, mid_oracle)
+    labels = cc.labels()
+    oracle = cc_labels_numpy(
+        np.concatenate(alla), np.concatenate(allb), None, N_V
+    )
+    assert np.array_equal(labels, oracle)
+
+
+def test_sharded_cc_valid_mask_and_padding():
+    a = np.array([0, 9, 17, 33], np.int32)
+    b = np.array([9, 17, 99, 207], np.int32)
+    ok = np.array([True, True, False, True])
+    cc = ShardedCC(N_V)
+    cc.fold(a, b, ok)  # 4 pairs pad unevenly across 8 shards
+    labels = cc.labels()
+    oracle = cc_labels_numpy(a[ok], b[ok], None, N_V)
+    assert np.array_equal(labels, oracle)
+
+
+def test_sharded_cc_state_is_striped():
+    # The VERDICT criterion: per-device state is capacity/S, not capacity.
+    cc = ShardedCC(N_V)
+    S = cc.S
+    assert S == 8
+    assert cc.parent.shape == (S, N_V // S)
+    assert cc.per_device_state_bytes() == (N_V // S) * 5
+    # Each row of the device-sharded parent is one device's stripe.
+    shards = cc.parent.addressable_shards
+    assert len(shards) == S
+    assert all(s.data.shape == (1, N_V // S) for s in shards)
+
+
+def test_sharded_cc_capacity_not_divisible_raises():
+    with pytest.raises(ValueError, match="not divisible"):
+        ShardedCC(N_V + 3)
+
+
+def test_sharded_cc_small_mesh():
+    a, b = _pairs(200, seed=9)
+    cc = ShardedCC(N_V, mesh=mesh_lib.make_mesh(2))
+    cc.fold(a, b)
+    assert np.array_equal(cc.labels(), cc_labels_numpy(a, b, None, N_V))
