@@ -663,16 +663,28 @@ def bench_triangles(args):
         ))
         dt_kernel = min(dt_kernel, time.perf_counter() - t0)
 
-    # Secondary figure: the capped-degree sparse windowed kernel (the
-    # large-n_v path, VERDICT r2 weak #2 asked for it to be benchmarked).
-    # Uniform endpoints: the sparse kernel targets bounded-degree windows
-    # (a Zipf hot vertex exceeds any practical degree cap).
+    # Secondary figure: the degree-bucketed sparse windowed path — the
+    # large-n_v workhorse (VERDICT r3 item 4). Zipf endpoints (a=1.6):
+    # realistic skew, no toy degree cap — the bucketed path adapts its
+    # table depth to each window's true max degree and splits the D x D
+    # intersections by actual row fill.
+    from gelly_tpu.library.triangles import (
+        _bucketize_window,
+        _stack_bucketed,
+        _window_triangle_count_bucketed_group,
+        window_triangles_bucketed,
+    )
+
     rng = np.random.default_rng(31)
     n_v_sp = 1 << 20
-    n_sp = min(args.edges, 1_000_000)
-    src_sp = rng.integers(0, n_v_sp, n_sp).astype(np.int64)
-    dst_sp = rng.integers(0, n_v_sp, n_sp).astype(np.int64)
+    # Fixed scale, decoupled from the dense workload's clamped edge count:
+    # per-dispatch tunnel RTT (~0.15s) needs ~10M edges to amortize, and
+    # the python oracle's one timed pass stays ~10s.
+    n_sp = 10_000_000
+    src_sp = (rng.zipf(1.6, n_sp) % n_v_sp).astype(np.int64)
+    dst_sp = (rng.zipf(1.6, n_sp) % n_v_sp).astype(np.int64)
     ts_sp = np.arange(n_sp, dtype=np.int64)
+    wsz = n_sp // 10
 
     def stream_sp():
         return edge_stream_from_source(
@@ -683,35 +695,63 @@ def bench_triangles(args):
             n_v_sp,
         )
 
-    sp_kw = dict(window_capacity=window_capacity, batch=8, max_degree=16)
-    list(window_triangle_counts_batched(stream_sp(), n_sp // 10, **sp_kw))
+    sp_kw = dict(window_capacity=4 * wsz, batch=10)
+    list(window_triangles_bucketed(stream_sp(), wsz, **sp_kw))
     dt_sp = float("inf")
     for _ in range(2):
         t0 = time.perf_counter()
-        ws_sp, cs = zip(*window_triangle_counts_batched(
-            stream_sp(), n_sp // 10, **sp_kw
+        ws_sp, cs = zip(*window_triangles_bucketed(
+            stream_sp(), wsz, **sp_kw
         ))
         cs = np.asarray(jnp.stack(cs))
         dt_sp = min(dt_sp, time.perf_counter() - t0)
+
+    # Device-bound kernel rate: host prep + payload staging untimed, one
+    # grouped dispatch timed (the figure a non-tunneled link sees; the
+    # pipeline figure above carries ~1s of host prep + wire).
+    payloads_sp = [
+        _bucketize_window(
+            src_sp[w0:w0 + wsz], dst_sp[w0:w0 + wsz],
+            np.ones(wsz, bool), n_v_sp, None,
+        )
+        for w0 in range(0, n_sp, wsz)
+    ]
+    payload_sp, t_cap, d_sp, h_cap, ladder_sp = _stack_bucketed(payloads_sp)
+    dev_sp = jax.tree.map(jax.device_put, payload_sp)
+    jax.tree.map(np.asarray, dev_sp)
+    np.asarray(_window_triangle_count_bucketed_group(
+        dev_sp, t_cap, d_sp, h_cap, ladder_sp
+    ))
+    dt_spk = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out_sp = _window_triangle_count_bucketed_group(
+            dev_sp, t_cap, d_sp, h_cap, ladder_sp
+        )
+        float(jnp.sum(out_sp))
+        dt_spk = min(dt_spk, time.perf_counter() - t0)
+
+    # Sparse-path python baseline: same per-window set-intersection oracle
+    # as the dense workload — also the parity oracle for the sparse
+    # counts. One full timed pass (rate is flat; it doubles as the oracle).
+    t0 = time.perf_counter()
+    sp_base: dict[int, int] = {}
+    for w0 in range(0, n_sp, wsz):
+        adj_sp: dict[int, set] = {}
+        seen_sp = set()
+        for i in range(w0, min(w0 + wsz, n_sp)):
+            a, b = int(src_sp[i]), int(dst_sp[i])
+            if a == b or (a, b) in seen_sp or (b, a) in seen_sp:
+                continue
+            seen_sp.add((a, b))
+            adj_sp.setdefault(a, set()).add(b)
+            adj_sp.setdefault(b, set()).add(a)
+        sp_base[w0 // wsz] = sum(
+            1 for a, b in seen_sp
+            for u in adj_sp[a] & adj_sp[b] if u < min(a, b)
+        )
+    dt_sp_base = time.perf_counter() - t0
     if not args.skip_parity:
-        # Same host set-intersection oracle pattern as the dense workload:
-        # a published sparse_kernel_eps must be for correct counts.
-        sp_base: dict[int, int] = {}
-        wsz = n_sp // 10
-        for w0 in range(0, n_sp, wsz):
-            adj_sp: dict[int, set] = {}
-            seen_sp = set()
-            for i in range(w0, min(w0 + wsz, n_sp)):
-                a, b = int(src_sp[i]), int(dst_sp[i])
-                if a == b or (a, b) in seen_sp or (b, a) in seen_sp:
-                    continue
-                seen_sp.add((a, b))
-                adj_sp.setdefault(a, set()).add(b)
-                adj_sp.setdefault(b, set()).add(a)
-            sp_base[w0 // wsz] = sum(
-                1 for a, b in seen_sp
-                for u in adj_sp[a] & adj_sp[b] if u < min(a, b)
-            )
         if dict(zip(ws_sp, cs.tolist())) != sp_base:
             raise SystemExit("sparse window-triangle parity FAILED")
 
@@ -742,8 +782,13 @@ def bench_triangles(args):
         raise SystemExit(f"triangle parity FAILED: {ours} vs {base}")
     return ("window_triangles_throughput", n_e / dt, n_e / dt_base,
             {"device_kernel_eps": round(n_e / dt_kernel, 1),
-             "sparse_kernel_eps": round(n_sp / dt_sp, 1),
-             "sparse_kernel_vertices": n_v_sp})
+             "sparse_pipeline_eps": round(n_sp / dt_sp, 1),
+             "sparse_pipeline_vs_baseline": round(dt_sp_base / dt_sp, 2),
+             "sparse_kernel_eps": round(n_sp / dt_spk, 1),
+             "sparse_vs_baseline": round(
+                 (n_sp / dt_spk) / (n_sp / dt_sp_base), 2),
+             "sparse_kernel_vertices": n_v_sp,
+             "sparse_edges": n_sp})
 
 
 def bench_bipartiteness(args):
@@ -1175,14 +1220,20 @@ def bench_sharded_state() -> dict:
         JAX_PLATFORMS="cpu",
         XLA_FLAGS=f"{kept} --xla_force_host_platform_device_count=8".strip(),
     )
-    proc = subprocess.run(
-        [sys.executable, "-I", "-c",
-         f"import sys; sys.path.insert(0, {here!r})\n" + _SHARDED_STATE_CHILD],
-        env=env, cwd=here, capture_output=True, text=True, timeout=900,
-    )
-    if proc.returncode != 0:
-        return {"metric": "sharded_state_cc", "error": proc.stderr[-400:]}
-    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-I", "-c",
+             f"import sys; sys.path.insert(0, {here!r})\n"
+             + _SHARDED_STATE_CHILD],
+            env=env, cwd=here, capture_output=True, text=True, timeout=900,
+        )
+        if proc.returncode != 0:
+            return {"metric": "sharded_state_cc",
+                    "error": proc.stderr[-400:]}
+        rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — never kill the headline line
+        return {"metric": "sharded_state_cc",
+                "error": f"{type(e).__name__}: {e}"[:400]}
     lo, hi = rows["1048576"], rows["8388608"]
     return {
         "metric": "sharded_state_cc",

@@ -238,6 +238,18 @@ class ShardedCC:
         b = np.asarray(b, np.int32)
         ok = (np.ones(a.shape, bool) if valid is None
               else np.asarray(valid, bool))
+        # Range-check valid ids on the host: the sharded gather/scatter
+        # would clamp an out-of-range slot onto a real one and silently
+        # corrupt its parent entry (same discipline as _check_slot_range
+        # in the other plans).
+        for name, arr in (("src", a), ("dst", b)):
+            live = arr[ok]
+            if live.size and (live.min() < 0 or live.max() >= self.n):
+                raise ValueError(
+                    f"ShardedCC.fold: {name} slot out of range "
+                    f"[0, {self.n}) (got "
+                    f"{int(live.min())}..{int(live.max())})"
+                )
         S = self.S
         L = -(-a.shape[0] // S)
         pad = L * S - a.shape[0]
