@@ -42,13 +42,23 @@ from .chunk import EdgeChunk
 def tumbling_window_events(
     chunks: Iterable[EdgeChunk], window_ms: int, stats: dict | None = None,
     initial_window: int | None = None, allowed_lateness: int = 0,
+    state_handle: dict | None = None,
+    initial_state: dict | None = None,
 ) -> Iterator[tuple]:
     """``initial_window`` seeds the open window (checkpoint resume: edges of
-    earlier, already-emitted windows count as late instead of re-opening)."""
+    earlier, already-emitted windows count as late instead of re-opening).
+
+    With lateness, ``state_handle`` (a caller-provided dict) gains an
+    ``"export"`` callable returning the live reorder-buffer state —
+    ``(wins list, [compact EdgeChunk per open window], closed_upto,
+    max_ts)`` — for checkpointing, and ``initial_state`` (a prior export,
+    re-shaped by the engine) seeds the buffer on resume so in-flight late
+    edges survive a restart.
+    """
     if allowed_lateness:
         yield from _tumbling_with_lateness(
             chunks, window_ms, stats if stats is not None else {},
-            initial_window, allowed_lateness,
+            initial_window, allowed_lateness, state_handle, initial_state,
         )
         return
     if stats is None:
@@ -88,6 +98,8 @@ def tumbling_window_events(
 def _tumbling_with_lateness(
     chunks: Iterable[EdgeChunk], window_ms: int, stats: dict,
     initial_window: int | None, lateness: int,
+    state_handle: dict | None = None,
+    initial_state: dict | None = None,
 ) -> Iterator[tuple]:
     """Watermark-gated reorder buffer (see module docstring).
 
@@ -117,6 +129,36 @@ def _tumbling_with_lateness(
     # Windows below this are closed: their edges are late (drop + count).
     closed_upto = initial_window if initial_window is not None else None
     max_ts = None
+    if initial_state is not None:
+        # Resume: re-seed the reorder buffer from a checkpoint export —
+        # one compact chunk per open window, every row live.
+        closed_upto = initial_state.get("closed_upto", closed_upto)
+        max_ts = initial_state.get("max_ts", max_ts)
+        for w, ch in zip(initial_state["wins"], initial_state["chunks"]):
+            idx = np.arange(ch.capacity, dtype=np.int32)
+            pending[int(w)] = [(ch, idx)]
+            stats["buffered_edges"] += ch.capacity
+        stats["open_windows"] = len(pending)
+
+    def export_state():
+        wins = sorted(pending)
+        out_chunks = []
+        for w in wins:
+            parts = pending[w]
+            out_chunks.append(EdgeChunk(*(
+                np.concatenate([
+                    np.asarray(getattr(ch, name))[idx]
+                    for ch, idx in parts
+                ])
+                for name in EdgeChunk._fields
+            )))
+        return {
+            "wins": wins, "chunks": out_chunks,
+            "closed_upto": closed_upto, "max_ts": max_ts,
+        }
+
+    if state_handle is not None:
+        state_handle["export"] = export_state
 
     def flush(upto):
         for w in sorted(w for w in pending if upto is None or w < upto):

@@ -1,0 +1,291 @@
+"""Mesh-sharded EXACT triangle counting — vertex-striped adjacency state.
+
+The reference's ``ExactTriangleCount`` is a keyed two-stage dataflow:
+``buildNeighborhood`` snapshots ship to each edge's key, where
+``IntersectNeighborhoods`` waits for BOTH endpoints' adjacency sets,
+intersects them, and emits per-vertex + global counter increments that a
+keyed ``SumAndEmitCounters`` accumulates
+(``M/example/ExactTriangleCount.java:74-134``). Here the same plan runs as
+XLA collectives over a vertex-striped mesh (VERDICT r3 item 7):
+
+- the capped-degree arrival-index table (``SparseTriangleCounts``'s
+  ``nbr/aidx/deg`` rows) is sharded by vertex stripe — device ``d`` owns
+  rows of slots ``{g : g % S == d}``, memory ∝ capacity/S per device;
+- per chunk, ONE ``shard_map`` program runs three keyed exchanges
+  (:func:`~gelly_tpu.parallel.partition.repartition_by_key`):
+
+  1. **presence + append**: both directions route to their row owners;
+     owners test presence (dedup vs earlier chunks), append fresh edges
+     (:func:`~gelly_tpu.library.triangles._row_append` on the local
+     stripe), and answer the canonical direction's freshness;
+  2. **row fetch**: each fresh canonical edge (a < b) requests row(b) from
+     its owner and delivers it to owner(a) — the "ship the adjacency
+     snapshot to the edge's key" hop, with [L, D]-wide payload leaves
+     riding the same all_to_all;
+  3. **count routing**: owner(a) intersects row(a) x row(b) under the
+     arrival-index closing-edge rule (only earlier-arrived edges count,
+     exactly the single-device kernel's ``aidx < lim``), adds a-side
+     counts locally, and routes (b, c_e) + (w, hits) increments to their
+     owners; the global total is a ``psum``.
+
+Counts are bit-identical to :class:`SparseExactTriangleStream` (asserted
+in tests on the 8-virtual-device CPU mesh). Arrival indices are i32 with
+no rebase on this tier (the single-device stream's ``arrival_budget``
+machinery); streams beyond ~2^31 edges should shard into runs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import segments
+from ..parallel import mesh as mesh_lib
+from ..parallel.mesh import SHARD_AXIS
+from ..parallel.partition import (
+    repartition_by_key,
+    slots_per_shard,
+    to_local_slot,
+    unstripe,
+)
+from .triangles import _row_append
+
+
+def _exchange_back(x: jax.Array, num_shards: int) -> jax.Array:
+    cap = x.shape[0] // num_shards
+    y = jax.lax.all_to_all(
+        x.reshape((num_shards, cap) + x.shape[1:]),
+        SHARD_AXIS, split_axis=0, concat_axis=0,
+    )
+    return y.reshape(x.shape)
+
+
+def _sharded_exact_chunk(nbr_loc, aidx_loc, deg_loc, counts_loc, overflow,
+                         a, b, idx, ok, num_shards, max_degree):
+    """One shard's view of a chunk step (inside shard_map). ``a < b``
+    canonical pairs, host-deduped within the chunk; ``idx`` arrival
+    indices; returns updated stripes + psum'd total delta."""
+    per = nbr_loc.shape[0]
+    D = max_degree
+    L = a.shape[0]
+    me = jax.lax.axis_index(SHARD_AXIS)
+    lane = me * L + jnp.arange(L, dtype=jnp.int32)
+
+    # ---- Phase 1: presence check + append, both directions. ----
+    k2 = jnp.concatenate([a, b])
+    o2 = jnp.concatenate([b, a])
+    i2 = jnp.concatenate([idx, idx])
+    ok2 = jnp.concatenate([ok, ok])
+    lane2 = jnp.concatenate([lane, jnp.full((L,), -1, jnp.int32)])
+    cap1 = 2 * L
+    k_r, pl_r, ok_r, _ = repartition_by_key(
+        k2, (o2, i2, lane2), ok2, num_shards, cap1
+    )
+    o_r, i_r, lane_r = pl_r
+    loc_r = to_local_slot(jnp.where(ok_r, k_r, 0), num_shards)
+    present = jnp.any(
+        nbr_loc[loc_r] == o_r[:, None], axis=1
+    ) & ok_r
+    fresh_r = ok_r & ~present
+    nbr_loc, aidx_loc, deg_loc, overflow = _row_append(
+        nbr_loc, aidx_loc, deg_loc, overflow,
+        loc_r, o_r, jnp.where(fresh_r, i_r, segments.INT_MAX),
+        fresh_r, D,
+    )
+    # Freshness verdict back to the canonical lanes (lane_r >= 0).
+    back_ok = _exchange_back(ok_r & (lane_r >= 0), num_shards)
+    back_lane = _exchange_back(lane_r, num_shards)
+    back_fresh = _exchange_back(fresh_r, num_shards)
+    my_lane = jnp.where(back_ok, back_lane - me * L, L)
+    fresh = jnp.zeros((L,), bool).at[
+        jnp.where(back_ok, my_lane, L)
+    ].set(back_fresh, mode="drop")
+    fresh = fresh & ok
+
+    # ---- Phase 2: fetch row(b) to owner(a). ----
+    cap2 = L
+    kb_r, plb_r, okb_r, _ = repartition_by_key(
+        b, (a, idx), fresh, num_shards, cap2
+    )
+    a_r, idx_r = plb_r
+    locb = to_local_slot(jnp.where(okb_r, kb_r, 0), num_shards)
+    rowb_nbr = jnp.where(okb_r[:, None], nbr_loc[locb], -1)
+    rowb_aidx = jnp.where(
+        okb_r[:, None], aidx_loc[locb], segments.INT_MAX
+    )
+    # Deliver (b, idx, row_b) to owner(a).
+    cap3 = num_shards * cap2  # worst case: every request's a on one shard
+    ka_r, pla_r, oka_r, _ = repartition_by_key(
+        a_r, (kb_r, idx_r, rowb_nbr, rowb_aidx), okb_r, num_shards, cap3
+    )
+    b_f, idx_f, rbn_f, rba_f = pla_r
+    loca = to_local_slot(jnp.where(oka_r, ka_r, 0), num_shards)
+    rowa_nbr = nbr_loc[loca]
+    rowa_aidx = aidx_loc[loca]
+    lim = jnp.where(oka_r, idx_f, 0)[:, None]
+    ok_u = (rowa_nbr >= 0) & (rowa_aidx < lim)
+    ok_v = (rbn_f >= 0) & (rba_f < lim)
+    match = (
+        (rowa_nbr[:, :, None] == rbn_f[:, None, :])
+        & ok_u[:, :, None] & ok_v[:, None, :]
+        & oka_r[:, None, None]
+    )
+    c_e = jnp.sum(match, axis=(1, 2)).astype(jnp.int64)
+    w_hits = jnp.sum(match, axis=2)  # [cap3, D] per row(a) entry
+
+    # ---- Phase 3: count attribution. ----
+    # a-side counts are local to this shard.
+    counts_loc = counts_loc.at[
+        jnp.where(oka_r, loca, per)
+    ].add(c_e, mode="drop")
+    # b-side + common-vertex increments route to their owners.
+    upd_k = jnp.concatenate([b_f, rowa_nbr.reshape(-1)])
+    upd_v = jnp.concatenate([c_e, w_hits.reshape(-1).astype(jnp.int64)])
+    upd_ok = jnp.concatenate([
+        oka_r & (c_e > 0),
+        (ok_u & (w_hits > 0)).reshape(-1),
+    ])
+    cap4 = upd_k.shape[0]
+    ku_r, vu_r, oku_r, _ = repartition_by_key(
+        jnp.where(upd_ok, upd_k, 0), upd_v, upd_ok, num_shards, cap4
+    )
+    counts_loc = counts_loc.at[
+        jnp.where(oku_r, to_local_slot(ku_r, num_shards), per)
+    ].add(jnp.where(oku_r, vu_r, 0), mode="drop")
+    total_delta = jax.lax.psum(jnp.sum(c_e), SHARD_AXIS)
+    return (nbr_loc, aidx_loc, deg_loc, counts_loc, overflow, total_delta)
+
+
+class ShardedExactTriangles:
+    """Streaming exact triangle counts over a vertex-striped mesh.
+
+    ``fold(stream_or_chunks)`` consumes edge chunks; ``final_counts()``
+    returns ``(per-vertex dict, global total)`` identical to
+    :func:`exact_triangle_count`'s. Degree overflow raises (checked per
+    fold — a dropped adjacency entry could hide triangles)."""
+
+    def __init__(self, stream, max_degree: int, capacity: int | None = None,
+                 mesh=None):
+        self.stream = stream
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        self.S = mesh_lib.num_shards(self.mesh)
+        self.n = capacity or stream.ctx.vertex_capacity
+        self.per = slots_per_shard(self.n, self.S)
+        self.D = max_degree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P(SHARD_AXIS))
+        S, per, D = self.S, self.per, self.D
+
+        @partial(jax.jit, out_shardings=(sh,) * 4)
+        def init():
+            def body():
+                return (
+                    jnp.full((1, per, D), -1, jnp.int32),
+                    jnp.full((1, per, D), segments.INT_MAX, jnp.int32),
+                    jnp.zeros((1, per), jnp.int32),
+                    jnp.zeros((1, per), jnp.int64),
+                )
+
+            return mesh_lib.shard_map_fn(
+                self.mesh, body, in_specs=(),
+                out_specs=(P(SHARD_AXIS),) * 4,
+            )()
+
+        self.nbr, self.aidx, self.deg, self.counts = init()
+        self.total = 0
+        self.n_seen = 0
+        self.overflow = 0
+        self._step = None
+
+    def _fold_chunk(self, chunk):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        src = np.asarray(chunk.src)
+        dst = np.asarray(chunk.dst)
+        okc = np.asarray(chunk.valid)
+        # Host prep, mirroring the single-device step: arrival indices
+        # count every valid lane; canonical orientation; intra-chunk dedup
+        # (presence vs earlier chunks is phase 1's job).
+        arrivals = (self.n_seen + np.cumsum(okc.astype(np.int64)) - 1)
+        self.n_seen += int(okc.sum())
+        a = np.minimum(src, dst).astype(np.int32)
+        b = np.maximum(src, dst).astype(np.int32)
+        ok = okc & (a != b)
+        pack = a.astype(np.int64) * self.n + b
+        seen_first = np.zeros(ok.shape, bool)
+        if ok.any():
+            _, first_pos = np.unique(pack[ok], return_index=True)
+            live_pos = np.nonzero(ok)[0]
+            seen_first[live_pos[first_pos]] = True
+        ok = ok & seen_first
+        if ok.any() and (a[ok].min() < 0 or b[ok].max() >= self.n):
+            raise ValueError("vertex slot out of range")
+
+        S = self.S
+        L = -(-a.shape[0] // S)
+        pad = L * S - a.shape[0]
+        if pad:
+            a = np.concatenate([a, np.zeros(pad, np.int32)])
+            b = np.concatenate([b, np.zeros(pad, np.int32)])
+            arrivals = np.concatenate([arrivals, np.zeros(pad, np.int64)])
+            ok = np.concatenate([ok, np.zeros(pad, bool)])
+        sh = NamedSharding(self.mesh, P(SHARD_AXIS))
+        key = L
+        if self._step is None or self._step[0] != key:
+            D, per = self.D, self.per
+
+            @partial(jax.jit,
+                     out_shardings=(sh, sh, sh, sh, None, None))
+            def step(nbr, aidx, deg, counts, a_, b_, i_, ok_):
+                def body(nl, al, dl, cl, aa, bb, ii, oo):
+                    out = _sharded_exact_chunk(
+                        nl[0], al[0], dl[0], cl[0], jnp.int32(0),
+                        aa[0], bb[0], ii[0], oo[0], S, D,
+                    )
+                    nl2, al2, dl2, cl2, ov, td = out
+                    return (nl2[None], al2[None], dl2[None], cl2[None],
+                            jax.lax.psum(ov, SHARD_AXIS), td)
+
+                return mesh_lib.shard_map_fn(
+                    self.mesh, body,
+                    in_specs=(P(SHARD_AXIS),) * 8,
+                    out_specs=(P(SHARD_AXIS),) * 4 + (P(), P()),
+                )(nbr, aidx, deg, counts, a_, b_, i_, ok_)
+
+            self._step = (key, step)
+        dev = [
+            jax.device_put(x.reshape(S, L), sh)
+            for x in (a, b, arrivals.astype(np.int32), ok)
+        ]
+        (self.nbr, self.aidx, self.deg, self.counts, ov, td) = (
+            self._step[1](self.nbr, self.aidx, self.deg, self.counts, *dev)
+        )
+        self.overflow += int(np.asarray(ov).reshape(-1)[0])
+        if self.overflow:
+            raise ValueError(
+                f"adjacency rows overflowed max_degree={self.D} "
+                f"({self.overflow} entries dropped); raise max_degree"
+            )
+        self.total += int(np.asarray(td).reshape(-1)[0])
+
+    def run(self) -> "ShardedExactTriangles":
+        for chunk in self.stream:
+            self._fold_chunk(chunk)
+        return self
+
+    def final_counts(self) -> dict[int, int]:
+        """Per-vertex local counts by raw id, with key ``-1`` = the global
+        total — the same contract as the single-device streams' (the
+        reference's ``(-1, count)`` global marker,
+        ``M/example/ExactTriangleCount.java:112``)."""
+        counts = unstripe(np.asarray(self.counts).reshape(-1), self.S)
+        out = {-1: int(self.total)}
+        nz = np.nonzero(counts)[0]
+        raw = self.stream.ctx.decode(nz)
+        for s, r in zip(nz.tolist(), raw.tolist()):
+            out[int(r)] = int(counts[s])
+        return out

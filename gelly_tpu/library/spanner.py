@@ -28,8 +28,12 @@ machine, the worst shape for an accelerator. The device aggregates in
 this module (``spanner_aggregation`` / ``sparse_spanner``) exist for the
 engine-plumbed mesh/combine semantics and for small streams; at measured
 4.9k edges/s (dense) / 0.4k edges/s (sparse) they are NOT peer options at
-scale, and their combine re-gates ``max_edges`` lanes sequentially —
-infeasible at the N ≥ 1M the sparse summary otherwise targets.
+scale. The sparse CROSS-PARTITION combine, however, batch-gates the
+donor's edges (:func:`_sparse_insert_edges_batched`): 64 vmapped
+bounded-BFS gates per round, a while_loop that stops at the donor's
+actual edge count — combine cost ∝ accepted edges, usable at the N ≥ 1M
+the sparse summary targets (the per-edge FOLD remains the host stage's
+job).
 """
 
 from __future__ import annotations
@@ -88,6 +92,45 @@ def _insert_edges(summary: SpannerSummary, src, dst, valid, k: int
         ), None
 
     out, _ = jax.lax.scan(step, summary, (src, dst, valid))
+    return out
+
+
+def _insert_edges_batched(s: SpannerSummary, esrc, edst, n_valid,
+                          k: int, batch: int = 64) -> SpannerSummary:
+    """Dense analog of :func:`_sparse_insert_edges_batched` — the combine's
+    batch gate (same batch size and candidate order, so the dense and
+    sparse plans accept identical sets when caps don't bind)."""
+    B = batch
+    cap = esrc.shape[0]
+    pad = (-cap) % B
+    esrc_p = jnp.pad(esrc, (0, pad))
+    edst_p = jnp.pad(edst, (0, pad))
+
+    def cond(st):
+        _, start = st
+        return start < n_valid
+
+    def body(st):
+        s_, start = st
+        u = jax.lax.dynamic_slice(esrc_p, (start,), (B,))
+        v = jax.lax.dynamic_slice(edst_p, (start,), (B,))
+        ok = (start + jnp.arange(B, dtype=jnp.int32)) < n_valid
+        reach = jax.vmap(lambda uu, vv: _within_k(s_.adj, uu, vv, k))(u, v)
+        take = ok & (u != v) & ~reach
+        adj = s_.adj.at[u, v].max(take)
+        adj = adj.at[v, u].max(take)
+        pos = s_.n + jnp.cumsum(take.astype(jnp.int32)).astype(jnp.int32) - 1
+        store = take & (pos < s_.esrc.shape[0])
+        tgt = jnp.where(store, pos, s_.esrc.shape[0])
+        esrc2 = s_.esrc.at[tgt].set(u, mode="drop")
+        edst2 = s_.edst.at[tgt].set(v, mode="drop")
+        overflow = s_.overflow | jnp.any(take & ~store)
+        return SpannerSummary(
+            adj, esrc2, edst2,
+            s_.n + jnp.sum(take).astype(jnp.int32), overflow,
+        ), start + B
+
+    out, _ = jax.lax.while_loop(cond, body, (s, jnp.int32(0)))
     return out
 
 
@@ -163,6 +206,85 @@ def _sparse_insert_edges(s: SparseSpannerSummary, src, dst, valid, k: int,
     return out
 
 
+def _row_append_batch(nbr, deg, over, key, val, ok, max_degree: int):
+    """Batched row append with in-batch rank handling (conflicting appends
+    to one row get consecutive slots — the batch analog of row_insert)."""
+    n = nbr.shape[0]
+    sort_key = jnp.where(ok, key, jnp.int32(n))
+    order = jnp.argsort(sort_key, stable=True)
+    k_s = sort_key[order]
+    first = jnp.searchsorted(k_s, k_s, side="left")
+    rank = jnp.arange(k_s.shape[0], dtype=jnp.int32) - first.astype(jnp.int32)
+    slot = deg[jnp.clip(k_s, 0, n - 1)] + rank
+    ok_s = ok[order]
+    fits = ok_s & (slot < max_degree)
+    over = over + jnp.sum(ok_s & (slot >= max_degree)).astype(jnp.int32)
+    flat = jnp.where(fits, k_s * max_degree + slot, n * max_degree)
+    nbr = nbr.reshape(-1).at[flat].set(
+        val[order], mode="drop"
+    ).reshape(n, max_degree)
+    deg = deg.at[jnp.where(fits, k_s, n)].add(1, mode="drop")
+    return nbr, deg, over
+
+
+def _sparse_insert_edges_batched(s: SparseSpannerSummary, esrc, edst,
+                                 n_valid, k: int, max_degree: int,
+                                 frontier_cap: int,
+                                 batch: int = 64) -> SparseSpannerSummary:
+    """Batch-gated combine insert (VERDICT r3 item 10): gate ``batch``
+    candidates at once against the CURRENT adjacency (vmapped bounded
+    BFS), accept every candidate the gate clears, insert, advance — a
+    ``while_loop`` over batches that stops at ``n_valid``, so combine cost
+    is ∝ the donor spanner's ACTUAL accepted edges, not the max_edges lane
+    capacity the old per-lane scan always paid.
+
+    Order note: candidates within one batch are not re-gated against each
+    other's acceptances, so the accept set can carry a few extra edges a
+    strictly sequential gate would have rejected — the same conservative
+    degradation class as the frontier/degree caps (extra edges, never a
+    broken k-stretch bound: every REJECTED edge was verified within k).
+    """
+    D = max_degree
+    B = batch
+    cap = esrc.shape[0]
+    pad = (-cap) % B
+    esrc_p = jnp.pad(esrc, (0, pad))
+    edst_p = jnp.pad(edst, (0, pad))
+
+    def cond(st):
+        _, start = st
+        return start < n_valid
+
+    def body(st):
+        s_, start = st
+        u = jax.lax.dynamic_slice(esrc_p, (start,), (B,))
+        v = jax.lax.dynamic_slice(edst_p, (start,), (B,))
+        ok = (start + jnp.arange(B, dtype=jnp.int32)) < n_valid
+        reach = jax.vmap(
+            lambda uu, vv: _within_k_sparse(s_.nbr, uu, vv, k, frontier_cap)
+        )(u, v)
+        take = ok & (u != v) & ~reach
+        nbr, deg, dover = s_.nbr, s_.deg, s_.deg_overflow
+        for a, b in ((u, v), (v, u)):
+            nbr, deg, dover = _row_append_batch(
+                nbr, deg, dover, a, b, take, D
+            )
+        # Batched edge-list append in candidate order.
+        pos = s_.n + jnp.cumsum(take.astype(jnp.int32)).astype(jnp.int32) - 1
+        store = take & (pos < s_.esrc.shape[0])
+        tgt = jnp.where(store, pos, s_.esrc.shape[0])
+        esrc2 = s_.esrc.at[tgt].set(u, mode="drop")
+        edst2 = s_.edst.at[tgt].set(v, mode="drop")
+        overflow = s_.overflow | jnp.any(take & ~store)
+        return SparseSpannerSummary(
+            nbr, deg, esrc2, edst2,
+            s_.n + jnp.sum(take).astype(jnp.int32), overflow, dover,
+        ), start + B
+
+    out, _ = jax.lax.while_loop(cond, body, (s, jnp.int32(0)))
+    return out
+
+
 def sparse_spanner(vertex_capacity: int, k: int, max_degree: int,
                    max_edges: int | None = None,
                    frontier_cap: int | None = None,
@@ -206,12 +328,13 @@ def sparse_spanner(vertex_capacity: int, k: int, max_degree: int,
 
     def combine(a, b):
         # Merge smaller into larger (CombineSpanners.reduce,
-        # Spanner.java:91-116), re-applying the gate edge-by-edge.
+        # Spanner.java:91-116), batch-re-gating the donor's edges: cost ∝
+        # the donor's accepted edges (while_loop stops at small.n), not
+        # the max_edges lane capacity (VERDICT r3 item 10).
         big = jax.tree.map(lambda x, y: jnp.where(a.n >= b.n, x, y), a, b)
         small = jax.tree.map(lambda x, y: jnp.where(a.n >= b.n, y, x), a, b)
-        valid = jnp.arange(small.esrc.shape[0]) < small.n
-        merged = _sparse_insert_edges(
-            big, small.esrc, small.edst, valid, k, D, F
+        merged = _sparse_insert_edges_batched(
+            big, small.esrc, small.edst, small.n, k, D, F
         )
         return merged._replace(
             overflow=merged.overflow | small.overflow,
@@ -380,12 +503,15 @@ def spanner(vertex_capacity: int, k: int,
 
 
     def combine(a: SpannerSummary, b: SpannerSummary) -> SpannerSummary:
-        # Merge smaller into larger (CombineSpanners.reduce, Spanner.java:91-116).
+        # Merge smaller into larger (CombineSpanners.reduce,
+        # Spanner.java:91-116), batch-re-gating the donor's edges — cost
+        # ∝ the donor's accepted edges, not the lane capacity (VERDICT
+        # r3 item 10; same batch semantics as the sparse combine).
         big, small = jax.tree.map(
             lambda x, y: jnp.where(a.n >= b.n, x, y), a, b
         ), jax.tree.map(lambda x, y: jnp.where(a.n >= b.n, y, x), a, b)
-        valid = jnp.arange(small.esrc.shape[0]) < small.n
-        merged = _insert_edges(big, small.esrc, small.edst, valid, k)
+        merged = _insert_edges_batched(big, small.esrc, small.edst,
+                                       small.n, k)
         return merged._replace(overflow=merged.overflow | small.overflow)
 
     from ..utils import native

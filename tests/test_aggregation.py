@@ -362,6 +362,64 @@ def test_allowed_lateness_sorted_stream_unaffected():
     assert got == want
 
 
+def test_allowed_lateness_checkpoint_resume_no_drops(tmp_path):
+    # VERDICT r3 item 9: allowed_lateness + checkpoint_path compose — the
+    # reorder buffer is serialized to a sidecar, so a resume mid-stream
+    # with IN-FLIGHT late edges drops nothing (Flink snapshots in-flight
+    # window state; M/SummaryAggregation.java:121-135 parity).
+    import jax.numpy as jnp
+
+    from gelly_tpu.core.io import EdgeChunkSource, TimeCharacteristic
+    from gelly_tpu.core.stream import edge_stream_from_source
+    from gelly_tpu.core.vertices import IdentityVertexTable
+    from gelly_tpu.engine.aggregation import SummaryAggregation
+
+    n_v = 16
+    # Timestamps shuffled within the lateness bound so edges from window
+    # w arrive AFTER window w+1 opens — the checkpoint below lands while
+    # those edges sit in the reorder buffer.
+    ts = np.array([0, 5, 12, 3, 8, 17, 14, 9, 23, 21, 16, 27, 26, 31, 29,
+                   35], np.int64)
+    src = np.arange(16, dtype=np.int64) % n_v
+    dst = (np.arange(16, dtype=np.int64) + 1) % n_v
+
+    def stream():
+        return edge_stream_from_source(
+            EdgeChunkSource(src, dst, timestamps=ts, chunk_size=4,
+                            table=IdentityVertexTable(n_v),
+                            time=TimeCharacteristic.EVENT),
+            n_v,
+        )
+
+    def count_agg():
+        return SummaryAggregation(
+            init=lambda: jnp.zeros((), jnp.int64),
+            fold=lambda s, c: s + jnp.sum(c.valid.astype(jnp.int64)),
+            combine=lambda a, b: a + b,
+        )
+
+    kw = dict(window_ms=10, allowed_lateness=10, checkpoint_every=1)
+    want = stream().aggregate(count_agg(), **kw).result()
+
+    p = str(tmp_path / "lat.npz")
+    # Partial run: stop after two emissions (checkpoints fire at the next
+    # chunk boundary after a close), with later-window edges already
+    # consumed into the reorder buffer.
+    it = iter(stream().aggregate(count_agg(), checkpoint_path=p, **kw))
+    next(it)
+    next(it)
+    del it
+    import os
+
+    assert os.path.exists(p + ".lateness")
+    got = stream().aggregate(
+        count_agg(), checkpoint_path=p, resume=True, **kw
+    ).result()
+    # Total folded edges must equal the uninterrupted run's (no buffered
+    # edge lost, none double-counted).
+    assert int(got) == int(want) == 16
+
+
 def test_allowed_lateness_requires_window_mode():
     from gelly_tpu.library.connected_components import connected_components
 
