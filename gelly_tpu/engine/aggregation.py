@@ -534,10 +534,14 @@ def run_aggregation(
         # hash tables stay private and there is no cross-worker eviction
         # thrash. On a single-core host this degenerates to one worker
         # (two workers there evict each other's tens-of-MB working sets
-        # and run ~2-4x slower than one).
-        ingest_workers = available_cores()
+        # and run ~2-4x slower than one). Capped at 8: staged units hold
+        # host payloads plus H2D device buffers, so an uncapped default
+        # would scale peak staging memory linearly with core count on
+        # large hosts — callers wanting more pass ingest_workers
+        # explicitly (the explicit value is honored unbounded).
+        ingest_workers = min(available_cores(), 8)
     if prefetch_depth is None:
-        prefetch_depth = max(2, ingest_workers)
+        prefetch_depth = max(2, min(ingest_workers, 8))
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)
     plan = _compiled_plan(agg, m)
@@ -628,7 +632,16 @@ def run_aggregation(
             if allowed_lateness:
                 import os as _os
 
-                side = checkpoint_path + ".lateness"
+                # Position-stamped sidecar names make the pair crash-safe:
+                # the sidecar for position P is written BEFORE the main
+                # file advances to P, and sidecars for older positions are
+                # pruned only AFTER the main os.replace succeeds — so
+                # whichever position the main file holds, its matching
+                # sidecar is on disk. The unstamped name is the legacy
+                # (pre-stamping) format, still position-checked.
+                side = f"{checkpoint_path}.lateness.{skip_until}"
+                if not _os.path.exists(side):
+                    side = checkpoint_path + ".lateness"
                 if _os.path.exists(side):
                     flat, side_pos, side_meta = load_checkpoint(side)
                     if side_pos != skip_until:
@@ -706,7 +719,8 @@ def run_aggregation(
             if allowed_lateness and "export" in lat_handle:
                 st = lat_handle["export"]()
                 save_checkpoint(
-                    checkpoint_path + ".lateness", st["chunks"],
+                    f"{checkpoint_path}.lateness.{chunks_consumed}",
+                    st["chunks"],
                     position=chunks_consumed,
                     meta={
                         "wins": [int(w) for w in st["wins"]],
@@ -722,6 +736,22 @@ def run_aggregation(
                     "current_window": current_window,
                 },
             )
+            if allowed_lateness:
+                # Only after the main write is durable: stale sidecars
+                # (older positions, or the legacy unstamped name) are no
+                # longer the matching pair for ANY reachable resume.
+                import glob as _glob
+                import os as _os
+
+                keep = f"{checkpoint_path}.lateness.{chunks_consumed}"
+                for old in _glob.glob(
+                    _glob.escape(checkpoint_path) + ".lateness*"
+                ):
+                    if old != keep:
+                        try:
+                            _os.unlink(old)
+                        except OSError:
+                            pass
 
         from ..utils.prefetch import prefetch
 

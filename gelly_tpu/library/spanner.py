@@ -102,6 +102,10 @@ def _insert_edges_batched(s: SpannerSummary, esrc, edst, n_valid,
     sparse plans accept identical sets when caps don't bind)."""
     B = batch
     cap = esrc.shape[0]
+    # n_valid counts ACCEPTED edges including ones whose store overflowed
+    # the lane capacity; clamp so an overflowed donor doesn't spin extra
+    # start-clamped iterations re-gating the tail lanes.
+    n_valid = jnp.minimum(n_valid, cap)
     pad = (-cap) % B
     esrc_p = jnp.pad(esrc, (0, pad))
     edst_p = jnp.pad(edst, (0, pad))
@@ -247,6 +251,9 @@ def _sparse_insert_edges_batched(s: SparseSpannerSummary, esrc, edst,
     D = max_degree
     B = batch
     cap = esrc.shape[0]
+    # Same clamp as _insert_edges_batched: n counts accepted edges even
+    # when the store overflowed the lane capacity.
+    n_valid = jnp.minimum(n_valid, cap)
     pad = (-cap) % B
     esrc_p = jnp.pad(esrc, (0, pad))
     edst_p = jnp.pad(edst, (0, pad))

@@ -86,6 +86,11 @@ class CompactIdSession:
         once the units ahead of it finish — without this, the release
         would be discarded and every later unit would park forever."""
         with self._turn_cv:
+            if seq < self._turn:
+                # Already passed (e.g. the engine's on_stage_error fires
+                # after the finally-block release completed): recording it
+                # again would leave a stale entry in _released forever.
+                return
             self._released.add(seq)
             while self._turn in self._released:
                 self._released.discard(self._turn)

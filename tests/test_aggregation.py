@@ -409,15 +409,77 @@ def test_allowed_lateness_checkpoint_resume_no_drops(tmp_path):
     next(it)
     next(it)
     del it
-    import os
+    import glob
 
-    assert os.path.exists(p + ".lateness")
+    # Sidecars are position-stamped (crash-atomic pair: the stamp ties
+    # each sidecar to the main-file position it belongs to).
+    assert glob.glob(p + ".lateness.*")
     got = stream().aggregate(
         count_agg(), checkpoint_path=p, resume=True, **kw
     ).result()
     # Total folded edges must equal the uninterrupted run's (no buffered
     # edge lost, none double-counted).
     assert int(got) == int(want) == 16
+
+
+def test_lateness_sidecar_crash_between_writes_recovers(tmp_path):
+    """A crash AFTER the new sidecar write but BEFORE the main-file
+    os.replace must leave the old (consistent) pair restorable — the
+    position-stamped sidecar names guarantee the main file's matching
+    sidecar is never overwritten in that window."""
+    import glob
+    import os
+    import shutil
+
+    from gelly_tpu.core.io import EdgeChunkSource, TimeCharacteristic
+    from gelly_tpu.core.stream import edge_stream_from_source
+    from gelly_tpu.core.vertices import IdentityVertexTable
+
+    n_v = 8
+    ts = np.array([0, 5, 12, 3, 8, 17, 14, 9, 23, 21, 16, 27, 26, 31, 29,
+                   35], np.int64)
+    src = np.arange(16, dtype=np.int64) % n_v
+    dst = (np.arange(16, dtype=np.int64) + 1) % n_v
+
+    def stream():
+        return edge_stream_from_source(
+            EdgeChunkSource(src, dst, timestamps=ts, chunk_size=4,
+                            table=IdentityVertexTable(n_v),
+                            time=TimeCharacteristic.EVENT),
+            n_v,
+        )
+
+    def count_agg():
+        return SummaryAggregation(
+            init=lambda: jnp.zeros((), jnp.int64),
+            fold=lambda s, c: s + jnp.sum(c.valid.astype(jnp.int64)),
+            combine=lambda a, b: a + b,
+        )
+
+    kw = dict(window_ms=10, allowed_lateness=10, checkpoint_every=1)
+    want = stream().aggregate(count_agg(), **kw).result()
+
+    p = str(tmp_path / "lat.npz")
+    it = iter(stream().aggregate(count_agg(), checkpoint_path=p, **kw))
+    next(it)
+    next(it)
+    del it
+    sides = glob.glob(p + ".lateness.*")
+    assert sides
+    # Simulate the crash window: a NEWER-position sidecar landed on disk
+    # but the main checkpoint never advanced.
+    pos = int(sides[0].rsplit(".", 1)[1])
+    shutil.copy(sides[0], f"{p}.lateness.{pos + 3}")
+    got = stream().aggregate(
+        count_agg(), checkpoint_path=p, resume=True, **kw
+    ).result()
+    assert int(got) == int(want) == 16
+    # A completed post-resume checkpoint prunes every stale sidecar.
+    leftover = glob.glob(p + ".lateness.*")
+    assert len(leftover) <= 1
+    if leftover:
+        assert not os.path.exists(f"{p}.lateness.{pos}") or \
+            leftover[0] != f"{p}.lateness.{pos}"
 
 
 def test_allowed_lateness_requires_window_mode():
