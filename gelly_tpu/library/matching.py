@@ -10,6 +10,14 @@ the device path) — and the inherently sequential per-edge decision folds
 chunk by chunk on the host via a native C++ stage (``native/matching.cc``)
 or, for pipelines that must stay resident, as a ``lax.scan`` on a single
 device (the stage is centralized in the reference too, ``:59-60``).
+
+Numeric divergence bound (pinned by
+``test_matching_f32_f64_threshold_divergence``): the two paths decide the
+eviction test ``w > 2*(wu + wv)`` in different precisions, so they can
+disagree exactly when the challenger's weight lands between the f64 and
+f32 roundings of the doubled collision sum — a window of at most one f32
+ulp of that sum. The host path is the reference-exact oracle (Java
+doubles); the device path trades that last ulp for staying resident.
 """
 
 from __future__ import annotations

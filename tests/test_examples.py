@@ -59,6 +59,39 @@ def test_matching_eviction():
     ]
 
 
+def test_matching_f32_f64_threshold_divergence():
+    """Pin exactly WHERE the device (f32) and host (f64) matching paths
+    diverge (VERDICT r4 item 10): the eviction test ``w > 2*(wu + wv)``
+    with f32-exact weights whose SUM is not f32-exact. ``1.0 + 3*2^-24``
+    rounds UP in f32 (ties-to-even), so the f32 threshold sits one ulp
+    above the f64 one; a challenger between the two is taken by the host
+    path (reference-exact, Java doubles,
+    CentralizedWeightedMatching.java:68-108) and rejected by the
+    device-resident f32 path. Both behaviors are documented; this test
+    asserts each stays put."""
+    b, c = 1.0, 3 * 2**-24
+    w = 2 + 2**-21
+    # Preconditions: all weights f32-exact; w straddles the two thresholds.
+    assert all(float(np.float32(x)) == x for x in (b, c, w))
+    assert w > 2.0 * (b + c)
+    assert not (
+        np.float32(w) > np.float32(2.0) * (np.float32(b) + np.float32(c))
+    )
+    edges = [(0, 1, b), (2, 3, c), (1, 3, w)]
+
+    def stream():
+        return edge_stream_from_edges(edges, vertex_capacity=8, chunk_size=4)
+
+    # Host (f64, reference-exact): the challenger evicts both incumbents.
+    host = weighted_matching(stream()).final_matching()
+    assert host == [(1, 3, w)]
+    # Device (f32): the rounded-up collision sum rejects the challenger
+    # and both incumbents survive (weights are f32-exact, so the decoded
+    # matching compares exactly).
+    dev = weighted_matching(stream(), device=True).final_matching()
+    assert dev == [(0, 1, b), (2, 3, c)]
+
+
 def test_matching_native_fold_matches_python_fallback(monkeypatch):
     """The C++ fold (native/matching.cc) and the Python host loop must
     produce identical final matchings AND identical ordered event streams."""
