@@ -196,7 +196,8 @@ def group_combine_payloads(payloads: list, groups: int,
 
 def bucket_stack_payloads(payloads: list, pad_values: dict,
                           min_bucket: int = 1024,
-                          quantum: int | None = None) -> dict:
+                          quantum: int | None = None,
+                          per_key: dict | None = None) -> dict:
     """Stack variable-length dict payloads to a shared power-of-two bucket.
 
     ``pad_values`` maps the variable-length array keys to their padding
@@ -214,19 +215,33 @@ def bucket_stack_payloads(payloads: list, pad_values: dict,
     the fold kernels' gather cost scales with PADDED lanes, so at
     multi-M pair counts the pow-of-two ladder would buy compile-cache
     stability with up to 2x device work.
+
+    ``per_key`` maps a padded key to its own ``(min_bucket, quantum)``:
+    keys whose natural length is far below the others' (e.g. per-segment
+    lengths vs per-pair members) then get their own bucket ladder instead
+    of inheriting the largest key's capacity — padding a short leaf to
+    the long leaves' bucket was measured as ~1/3 of the compact codec's
+    wire bytes. Keys not listed share the default ladder as before.
     """
+    def _cap(longest, mb, q):
+        if q:
+            return max(mb, -(-longest // q) * q)
+        return max(mb, 1 << max(0, longest - 1).bit_length())
+
+    per_key = per_key or {}
+    shared = [k for k in pad_values if k not in per_key]
     longest = max(
-        (p[k].shape[0] for p in payloads for k in pad_values), default=0
+        (p[k].shape[0] for p in payloads for k in shared), default=0
     )
-    if quantum:
-        cap = max(min_bucket, -(-longest // quantum) * quantum)
-    else:
-        cap = max(min_bucket, 1 << max(0, longest - 1).bit_length())
+    caps = {k: _cap(longest, min_bucket, quantum) for k in shared}
+    for k, (mb, q) in per_key.items():
+        lk = max((p[k].shape[0] for p in payloads), default=0)
+        caps[k] = _cap(lk, mb, q)
     out = {}
     for key in payloads[0]:
         if key in pad_values:
             stacked = np.full(
-                (len(payloads), cap), pad_values[key],
+                (len(payloads), caps[key]), pad_values[key],
                 dtype=payloads[0][key].dtype,
             )
             for i, p in enumerate(payloads):

@@ -49,10 +49,21 @@ class CompactIdSession:
         self.capacity = int(capacity)
         self._lock = threading.Lock()
         self._turn_cv = threading.Condition()
+        # Native open-addressing table when the toolchain is available
+        # (one hash probe per id, no per-call rebuild — the numpy sorted
+        # array's O(known) merge per assign was the Twitter-scale ingest
+        # bottleneck); numpy sorted-array fallback otherwise.
+        self._native = None
+        from ..utils import native as _nat
+
+        if _nat.compact_session_available():
+            self._native = _nat.NativeCompactSession(self.capacity)
         self.reset()
 
     def reset(self) -> None:
         with self._lock:
+            if self._native is not None:
+                self._native.reset()
             # Sorted global ids + their cids (aligned): lookups are one
             # searchsorted; inserts are a sorted merge. Both run at pair
             # rate on the ingest thread, far off the per-edge path.
@@ -99,6 +110,8 @@ class CompactIdSession:
 
     @property
     def assigned(self) -> int:
+        if self._native is not None:
+            return self._native.assigned
         return self._next
 
     def assign(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray, int]:
@@ -109,6 +122,16 @@ class CompactIdSession:
         """
         ids = np.ascontiguousarray(ids, np.int32)
         with self._lock:
+            if self._native is not None:
+                cids, new_ids, base = self._native.assign(ids)
+                if base < 0:
+                    raise CompactSpaceOverflow(
+                        f"compact space overflow: more than "
+                        f"{self.capacity} distinct vertices; raise "
+                        "compact_capacity (it bounds distinct touched "
+                        "vertices per window, not edges)"
+                    )
+                return cids, new_ids, base
             pos = np.searchsorted(self._known, ids)
             found = pos < self._known.shape[0]
             found[found] = self._known[pos[found]] == ids[found]
@@ -151,6 +174,13 @@ class CompactIdSession:
         """cids of already-assigned ids (raises on unknown ids)."""
         ids = np.ascontiguousarray(ids, np.int32)
         with self._lock:
+            if self._native is not None:
+                cids, bad = self._native.lookup(ids)
+                if bad:
+                    raise KeyError(
+                        f"{bad} ids have no compact assignment"
+                    )
+                return cids
             if self._known.shape[0] == 0:
                 if ids.size:
                     raise KeyError(
@@ -174,6 +204,10 @@ class CompactIdSession:
         summary is the durable record of every assignment, so resume needs
         no separate codec snapshot."""
         vertex_of = np.asarray(vertex_of)
+        if self._native is not None:
+            with self._lock:
+                self._native.rebuild(vertex_of)
+            return
         cids = np.nonzero(vertex_of >= 0)[0].astype(np.int32)
         ids = vertex_of[cids].astype(np.int32)
         order = np.argsort(ids)

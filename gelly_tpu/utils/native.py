@@ -138,6 +138,48 @@ def _load_combiner() -> ctypes.CDLL:
             lib._has_sparse_idx = True
         except AttributeError:
             lib._has_sparse_idx = False
+        # Compact-id session (persistent open-addressing id->cid table) —
+        # same separate-binding rationale as above.
+        try:
+            lib.compact_session_create.restype = ctypes.c_void_p
+            lib.compact_session_create.argtypes = [ctypes.c_int32]
+            lib.compact_session_destroy.restype = None
+            lib.compact_session_destroy.argtypes = [ctypes.c_void_p]
+            lib.compact_session_reset.restype = None
+            lib.compact_session_reset.argtypes = [ctypes.c_void_p]
+            lib.compact_session_assigned.restype = ctypes.c_int32
+            lib.compact_session_assigned.argtypes = [ctypes.c_void_p]
+            lib.compact_session_assign.restype = ctypes.c_int64
+            lib.compact_session_assign.argtypes = [
+                ctypes.c_void_p, _i32p, ctypes.c_int64, _i32p,
+            ]
+            lib.compact_session_new_ids.restype = None
+            lib.compact_session_new_ids.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, _i32p,
+            ]
+            lib.compact_session_lookup.restype = ctypes.c_int64
+            lib.compact_session_lookup.argtypes = [
+                ctypes.c_void_p, _i32p, ctypes.c_int64, _i32p,
+            ]
+            lib.compact_session_rebuild.restype = ctypes.c_int
+            lib.compact_session_rebuild.argtypes = [
+                ctypes.c_void_p, _i32p, ctypes.c_int32,
+            ]
+            lib._has_compact_session = True
+        except AttributeError:
+            lib._has_compact_session = False
+        # Fused unit-level segment codec — separate-binding rationale as
+        # above.
+        try:
+            lib.cc_unit_forest_segments.restype = ctypes.c_int
+            lib.cc_unit_forest_segments.argtypes = [
+                _i32p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int64, _i32p, ctypes.c_int64, _i32p,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib._has_unit_segments = True
+        except AttributeError:
+            lib._has_unit_segments = False
         lib._sigs_set = True
     return lib
 
@@ -417,6 +459,114 @@ def sparse_idx_available() -> bool:
     return available("chunk_combiner") and getattr(
         _load_combiner(), "_has_sparse_idx", False
     )
+
+
+def compact_session_available() -> bool:
+    """The combiner exports the persistent compact-id session."""
+    return available("chunk_combiner") and getattr(
+        _load_combiner(), "_has_compact_session", False
+    )
+
+
+def unit_segments_available() -> bool:
+    """The combiner exports the fused unit-level segment codec."""
+    return available("chunk_combiner") and getattr(
+        _load_combiner(), "_has_unit_segments", False
+    )
+
+
+def cc_unit_forest_segments(src: np.ndarray, dst: np.ndarray,
+                            valid: np.ndarray | None, n_v: int,
+                            block: int = 1 << 16):
+    """Segment-format spanning forest of one merge-window unit: dedup →
+    cache-blocked level-1 forests → level-2 merge. Returns ``(members
+    i32[t], lengths i32[s])`` — members grouped by component, each
+    component's ROOT first in its segment (the device fold derives the
+    root-row index of every pair as its segment start, so the pair wire
+    is 4 bytes/member instead of 8). GIL released during the call."""
+    lib = _load_combiner()
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    cap = 2 * max(1, src.shape[0])
+    out_v = np.empty((cap,), np.int32)
+    out_len = np.empty((cap,), np.int32)
+    counts = np.zeros((2,), np.int64)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, np.uint8)
+        vp = valid.ctypes.data_as(_u8p)
+    rc = lib.cc_unit_forest_segments(
+        _as_i32p(src), _as_i32p(dst), vp, src.shape[0], n_v, block,
+        _as_i32p(out_v), cap, _as_i32p(out_len), cap,
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    _sparse_rc_check(rc, "cc_unit_forest_segments")
+    return out_v[: counts[0]], out_len[: counts[1]]
+
+
+class NativeCompactSession:
+    """RAII handle over the native open-addressing id->cid table
+    (``native/chunk_combiner.cc``): one hash probe per id, O(1) amortized
+    insert — replaces the numpy sorted-array session whose per-call
+    O(known) rebuild was the Twitter-scale ingest bottleneck. NOT
+    internally locked; callers (``ops.compact_space.CompactIdSession``)
+    serialize access."""
+
+    def __init__(self, capacity: int):
+        self._lib = _load_combiner()
+        self._h = self._lib.compact_session_create(int(capacity))
+        if not self._h:
+            raise MemoryError("compact_session_create failed")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.compact_session_destroy(h)
+            self._h = None
+
+    def reset(self) -> None:
+        self._lib.compact_session_reset(self._h)
+
+    @property
+    def assigned(self) -> int:
+        return int(self._lib.compact_session_assigned(self._h))
+
+    def assign(self, ids: np.ndarray):
+        """(cids, new_ids, base) — fresh ids get cids in first-seen ARRAY
+        order. Returns base=-1 on capacity overflow (session unchanged)."""
+        ids = np.ascontiguousarray(ids, np.int32)
+        out = np.empty(ids.shape[0], np.int32)
+        base = self._lib.compact_session_assign(
+            self._h, _as_i32p(ids), ids.shape[0], _as_i32p(out)
+        )
+        if base == -4:
+            raise MemoryError("compact_session_assign: allocation failed")
+        if base < 0:
+            return None, None, -1
+        top = self.assigned
+        new_ids = np.empty(top - base, np.int32)
+        if top > base:
+            self._lib.compact_session_new_ids(
+                self._h, base, top, _as_i32p(new_ids)
+            )
+        return out, new_ids, int(base)
+
+    def lookup(self, ids: np.ndarray):
+        """(cids, n_unknown) — unknown ids get cid -1."""
+        ids = np.ascontiguousarray(ids, np.int32)
+        out = np.empty(ids.shape[0], np.int32)
+        bad = self._lib.compact_session_lookup(
+            self._h, _as_i32p(ids), ids.shape[0], _as_i32p(out)
+        )
+        return out, int(bad)
+
+    def rebuild(self, vertex_of: np.ndarray) -> None:
+        vertex_of = np.ascontiguousarray(vertex_of, np.int32)
+        rc = self._lib.compact_session_rebuild(
+            self._h, _as_i32p(vertex_of), vertex_of.shape[0]
+        )
+        if rc != 0:
+            raise MemoryError("compact_session_rebuild: allocation failed")
 
 
 def cc_chunk_combine_sparse_idx(src: np.ndarray, dst: np.ndarray,

@@ -15,6 +15,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <new>
 
 namespace {
 
@@ -427,6 +428,414 @@ int64_t degree_chunk_deltas_sparse(const int32_t* src, const int32_t* dst,
     ++k;
   }
   return k;
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------------------ //
+// Persistent compact-id session — the native backing of
+// gelly_tpu/ops/compact_space.py's CompactIdSession.
+//
+// The numpy session kept (id -> cid) as a SORTED array pair: every
+// assign with fresh ids rebuilt the whole table (O(known) memmove) plus
+// per-id searchsorted probes — measured as THE Twitter-scale ingest
+// bottleneck (20.1s of a 36.1s run; the combiner it wraps costs ~4s).
+// Here the map is an open-addressing hash table with geometric growth:
+// one multiplicative-hash probe per id, O(1) amortized insert, no
+// per-call rebuild. This is the same table discipline as the reference's
+// per-subtask HashMap state (M/SummaryBulkAggregation.java:109-130),
+// owned by the ingest host.
+
+namespace {
+
+struct CompactSession {
+  int32_t* table = nullptr;    // open addressing: slot -> cid, or -1
+  int32_t* vert_of = nullptr;  // vert_of[cid] = global vertex id
+  int64_t tsize = 0;
+  int64_t mask = 0;
+  int32_t count = 0;
+  int32_t capacity = 0;
+};
+
+inline int64_t cs_hash(int32_t v, int64_t mask) {
+  return (static_cast<uint32_t>(v) * 2654435761u) & mask;
+}
+
+// Geometrically grown intern table (vs LocalTable's sized-to-worst-case
+// policy): for merge passes whose DISTINCT count is far below the input
+// count, growth keeps most probes cache-resident instead of walking a
+// DRAM-sized table from the first insert.
+struct GrowTable {
+  int32_t* table = nullptr;
+  int32_t* vert = nullptr;
+  int32_t* parent = nullptr;
+  int64_t tsize = 0;
+  int64_t mask = 0;
+  int32_t count = 0;
+
+  bool init(int64_t tsize0) {
+    tsize = tsize0;
+    mask = tsize - 1;
+    table = static_cast<int32_t*>(std::malloc(tsize * sizeof(int32_t)));
+    vert = static_cast<int32_t*>(std::malloc(tsize / 2 * sizeof(int32_t)));
+    parent = static_cast<int32_t*>(std::malloc(tsize / 2 * sizeof(int32_t)));
+    if (!table || !vert || !parent) return false;
+    std::memset(table, 0xff, tsize * sizeof(int32_t));
+    return true;
+  }
+
+  ~GrowTable() {
+    std::free(table);
+    std::free(vert);
+    std::free(parent);
+  }
+
+  bool grow() {
+    tsize *= 2;
+    mask = tsize - 1;
+    int32_t* t = static_cast<int32_t*>(std::malloc(tsize * sizeof(int32_t)));
+    int32_t* v2 = static_cast<int32_t*>(
+        std::realloc(vert, tsize / 2 * sizeof(int32_t)));
+    if (v2) vert = v2;
+    int32_t* p2 = static_cast<int32_t*>(
+        std::realloc(parent, tsize / 2 * sizeof(int32_t)));
+    if (p2) parent = p2;
+    if (!t || !v2 || !p2) { std::free(t); return false; }
+    std::memset(t, 0xff, tsize * sizeof(int32_t));
+    for (int32_t c = 0; c < count; ++c) {
+      int64_t i = cs_hash(vert[c], mask);
+      while (t[i] >= 0) i = (i + 1) & mask;
+      t[i] = c;
+    }
+    std::free(table);
+    table = t;
+    return true;
+  }
+
+  // Local index of v, interning on first sight; -1 on allocation failure.
+  inline int32_t intern(int32_t v) {
+    int64_t i = cs_hash(v, mask);
+    while (true) {
+      const int32_t e = table[i];
+      if (e < 0) {
+        if (2 * static_cast<int64_t>(count + 1) >= tsize) {
+          if (!grow()) return -1;
+          return intern(v);
+        }
+        table[i] = count;
+        vert[count] = v;
+        parent[count] = count;
+        return count++;
+      }
+      if (vert[e] == v) return e;
+      i = (i + 1) & mask;
+    }
+  }
+};
+
+// (Re)build the probe table at size tsize from vert_of[0..count).
+bool cs_rehash(CompactSession* s, int64_t tsize) {
+  int32_t* t = static_cast<int32_t*>(std::malloc(tsize * sizeof(int32_t)));
+  if (!t) return false;
+  std::memset(t, 0xff, tsize * sizeof(int32_t));
+  const int64_t mask = tsize - 1;
+  for (int32_t c = 0; c < s->count; ++c) {
+    const int32_t v = s->vert_of[c];
+    if (v < 0) continue;  // rebuild hole (staged-but-unfolded cid)
+    int64_t i = cs_hash(v, mask);
+    while (t[i] >= 0) i = (i + 1) & mask;
+    t[i] = c;
+  }
+  std::free(s->table);
+  s->table = t;
+  s->tsize = tsize;
+  s->mask = mask;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* compact_session_create(int32_t capacity) {
+  CompactSession* s = new (std::nothrow) CompactSession();
+  if (!s) return nullptr;
+  s->capacity = capacity;
+  s->vert_of = static_cast<int32_t*>(
+      std::malloc(sizeof(int32_t) * (capacity > 0 ? capacity : 1)));
+  if (!s->vert_of || !cs_rehash(s, 1024)) {
+    std::free(s->vert_of);
+    std::free(s->table);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void compact_session_destroy(void* h) {
+  if (!h) return;
+  CompactSession* s = static_cast<CompactSession*>(h);
+  std::free(s->table);
+  std::free(s->vert_of);
+  delete s;
+}
+
+void compact_session_reset(void* h) {
+  CompactSession* s = static_cast<CompactSession*>(h);
+  s->count = 0;
+  std::memset(s->table, 0xff, s->tsize * sizeof(int32_t));
+}
+
+int32_t compact_session_assigned(void* h) {
+  return static_cast<CompactSession*>(h)->count;
+}
+
+// Assign cids to ids (fresh ids get count, count+1, ... in first-seen
+// ARRAY order). Returns the pre-call count (the new block's base), or
+// -1 on capacity overflow (the session is rolled back to the pre-call
+// state), or -4 on allocation failure.
+int64_t compact_session_assign(void* h, const int32_t* ids, int64_t n,
+                               int32_t* out_cids) {
+  CompactSession* s = static_cast<CompactSession*>(h);
+  const int32_t base = s->count;
+  for (int64_t j = 0; j < n; ++j) {
+    const int32_t v = ids[j];
+    int64_t i = cs_hash(v, s->mask);
+    int32_t e;
+    while ((e = s->table[i]) >= 0 && s->vert_of[e] != v) {
+      i = (i + 1) & s->mask;
+    }
+    if (e >= 0) {
+      out_cids[j] = e;
+      continue;
+    }
+    if (s->count >= s->capacity) {
+      // Roll back this call's inserts (atomic-assign contract): rebuild
+      // the probe table from the first `base` entries. Error path only.
+      s->count = base;
+      if (!cs_rehash(s, s->tsize)) return -4;
+      return -1;
+    }
+    s->table[i] = s->count;
+    s->vert_of[s->count] = v;
+    out_cids[j] = s->count++;
+    if (2 * static_cast<int64_t>(s->count) >= s->tsize) {
+      if (!cs_rehash(s, s->tsize * 2)) return -4;
+    }
+  }
+  return base;
+}
+
+// Copy vert_of[from:to) (the fresh ids of an assign block) into out.
+void compact_session_new_ids(void* h, int32_t from, int32_t to,
+                             int32_t* out) {
+  CompactSession* s = static_cast<CompactSession*>(h);
+  std::memcpy(out, s->vert_of + from,
+              sizeof(int32_t) * static_cast<size_t>(to - from));
+}
+
+// cids of already-assigned ids; unknown ids get -1. Returns the number
+// of unknown ids.
+int64_t compact_session_lookup(void* h, const int32_t* ids, int64_t n,
+                               int32_t* out_cids) {
+  CompactSession* s = static_cast<CompactSession*>(h);
+  int64_t bad = 0;
+  for (int64_t j = 0; j < n; ++j) {
+    const int32_t v = ids[j];
+    int64_t i = cs_hash(v, s->mask);
+    int32_t e;
+    while ((e = s->table[i]) >= 0 && s->vert_of[e] != v) {
+      i = (i + 1) & s->mask;
+    }
+    out_cids[j] = e;
+    if (e < 0) ++bad;
+  }
+  return bad;
+}
+
+// ---------------------------------------------------------------- //
+// Fused unit-level forest codec (VERDICT r4 items 1+7): one call per
+// merge-window unit replaces the per-chunk combine + numpy group
+// combine + per-pair (v, ri) wire with
+//
+//   1. cache-BLOCKED level-1 forests: union-find over `block`-edge
+//      slices whose intern tables stay cache-resident (the whole-chunk
+//      table at 2^20-edge chunks is 32MB — DRAM-resident probes were
+//      the measured cost; 2^18-edge blocks with software prefetch of
+//      the next edges' hash slots measured fastest), emitting
+//      (vertex, root) pairs;
+//      [A direct-mapped duplicate-edge filter was tried and REMOVED:
+//      with 68% hit rate it still slowed L1 ~1.5x, because duplicate
+//      edges' intern probes hit already-hot cache lines while the
+//      filter added one cold 512KB-random access per edge.]
+//   2. one level-2 merge over the level-1 pairs (∝ touched vertices,
+//      not edges) in a GEOMETRICALLY GROWN table — sizing it to the
+//      pair count upfront (the LocalTable policy) put every probe in
+//      a DRAM-sized table; growth keeps most probes in cache;
+//   3. SEGMENT-format output: members grouped by component with the
+//      component root placed FIRST in its segment. The device fold
+//      reconstructs each pair's root-row index as its segment start
+//      (cumsum of lengths), so the wire carries 4 bytes/pair + one
+//      length per component instead of the 8-byte (v, ri) pair — the
+//      H2D link is the pipeline's scarce resource.
+//
+// Pure function (no session state): cid assignment stays in the
+// ordered compact_session_assign turn, so concurrent ingest workers
+// keep the heavy combine parallel. Output members are global VERTEX
+// ids; the caller remaps them to cids with one session.assign pass
+// (order-preserving, so the segment structure is unchanged).
+//
+//   out_v   : member vertex ids, root-first per segment (cap >= touched)
+//   out_len : segment lengths (cap >= segments)
+//   out_counts[2]: {n_members, n_segments}
+//
+// Returns 0, -2 on a slot outside [0, n_v), -3 on cap overflow, -4 on
+// allocation failure.
+int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
+                            const uint8_t* valid, int64_t n, int32_t n_v,
+                            int64_t block, int32_t* out_v, int64_t cap_v,
+                            int32_t* out_len, int64_t cap_len,
+                            int64_t* out_counts) {
+  out_counts[0] = 0;
+  out_counts[1] = 0;
+  if (block <= 0) block = 1 << 18;
+  // Level-1 pair scratch, grown geometrically (practical size ∝ touched
+  // vertices per block summed, far below the 2n worst case).
+  int64_t pcap = 1 << 16;
+  int64_t np_ = 0;
+  int32_t* pv = static_cast<int32_t*>(std::malloc(pcap * sizeof(int32_t)));
+  int32_t* pr = static_cast<int32_t*>(std::malloc(pcap * sizeof(int32_t)));
+  if (!pv || !pr) {
+    std::free(pv); std::free(pr);
+    return -4;
+  }
+  int rc = 0;
+  for (int64_t lo = 0; lo < n && rc == 0; lo += block) {
+    const int64_t hi = lo + block < n ? lo + block : n;
+    LocalTable t;
+    if (!t.init(hi - lo)) { rc = -4; break; }
+    for (int64_t i = lo; i < hi; ++i) {
+      if (i + 8 < hi) {
+        // Hide the table-probe latency of edge i+8 behind edge i's
+        // work (the intern loop is latency-bound on its first probe).
+        __builtin_prefetch(
+            &t.table[(static_cast<uint32_t>(src[i + 8]) * 2654435761u)
+                     & t.mask]);
+        __builtin_prefetch(
+            &t.table[(static_cast<uint32_t>(dst[i + 8]) * 2654435761u)
+                     & t.mask]);
+      }
+      if (valid != nullptr && !valid[i]) continue;
+      const int32_t u = src[i];
+      const int32_t v = dst[i];
+      if (u < 0 || u >= n_v || v < 0 || v >= n_v) { rc = -2; break; }
+      const int32_t lu = t.intern(u);
+      const int32_t lv = t.intern(v);
+      const int32_t ru = find_root(t.parent, lu);
+      const int32_t rv = find_root(t.parent, lv);
+      if (ru != rv) {
+        if (t.vert[ru] < t.vert[rv]) t.parent[rv] = ru;
+        else t.parent[ru] = rv;
+      }
+    }
+    if (rc) break;
+    if (np_ + t.count > pcap) {
+      while (np_ + t.count > pcap) pcap *= 2;
+      int32_t* nv2 = static_cast<int32_t*>(
+          std::realloc(pv, pcap * sizeof(int32_t)));
+      if (nv2) pv = nv2;
+      int32_t* nr2 = static_cast<int32_t*>(
+          std::realloc(pr, pcap * sizeof(int32_t)));
+      if (nr2) pr = nr2;
+      if (!nv2 || !nr2) { rc = -4; break; }
+    }
+    for (int32_t j = 0; j < t.count; ++j) {
+      pv[np_] = t.vert[j];
+      pr[np_] = t.vert[find_root(t.parent, j)];
+      ++np_;
+    }
+  }
+  if (rc) { std::free(pv); std::free(pr); return rc; }
+  // Level 2: merge the per-block forests in a growing table.
+  GrowTable t2;
+  if (!t2.init(1 << 17)) { std::free(pv); std::free(pr); return -4; }
+  for (int64_t i = 0; i < np_; ++i) {
+    const int32_t lu = t2.intern(pv[i]);
+    const int32_t lv = t2.intern(pr[i]);
+    if (lu < 0 || lv < 0) { rc = -4; break; }
+    const int32_t ru = find_root(t2.parent, lu);
+    const int32_t rv = find_root(t2.parent, lv);
+    if (ru != rv) {
+      if (t2.vert[ru] < t2.vert[rv]) t2.parent[rv] = ru;
+      else t2.parent[ru] = rv;
+    }
+  }
+  std::free(pv);
+  std::free(pr);
+  if (rc) return rc;
+  const int32_t count = t2.count;
+  if (count > cap_v) return -3;
+  // Segment assembly: segments numbered by first-touch of their root;
+  // the root entry goes FIRST in its segment (the device derives each
+  // pair's root-row index as its segment start).
+  int32_t* rloc = static_cast<int32_t*>(std::malloc(
+      sizeof(int32_t) * (count > 0 ? count : 1)));
+  int32_t* segof = static_cast<int32_t*>(std::malloc(
+      sizeof(int32_t) * (count > 0 ? count : 1)));
+  if (!rloc || !segof) { std::free(rloc); std::free(segof); return -4; }
+  std::memset(segof, 0xff, sizeof(int32_t) * (count > 0 ? count : 1));
+  int32_t nseg = 0;
+  for (int32_t j = 0; j < count; ++j) {
+    rloc[j] = find_root(t2.parent, j);
+    if (segof[rloc[j]] < 0) {
+      if (nseg >= cap_len) { std::free(rloc); std::free(segof); return -3; }
+      segof[rloc[j]] = nseg++;
+    }
+  }
+  int32_t* start = static_cast<int32_t*>(std::malloc(
+      sizeof(int32_t) * (nseg > 0 ? nseg : 1)));
+  if (!start) { std::free(rloc); std::free(segof); return -4; }
+  std::memset(start, 0, sizeof(int32_t) * (nseg > 0 ? nseg : 1));
+  for (int32_t j = 0; j < count; ++j) start[segof[rloc[j]]] += 1;
+  int32_t acc = 0;
+  for (int32_t s = 0; s < nseg; ++s) {
+    out_len[s] = start[s];
+    const int32_t c = start[s];
+    start[s] = acc;
+    acc += c;
+  }
+  // Two-pass fill: roots at their segment starts first, then members
+  // appended from start+1 onward (start[] doubles as the fill cursor).
+  for (int32_t j = 0; j < count; ++j) {
+    if (j == rloc[j]) out_v[start[segof[j]]] = t2.vert[j];
+  }
+  for (int32_t s = 0; s < nseg; ++s) start[s] += 1;
+  for (int32_t j = 0; j < count; ++j) {
+    if (j != rloc[j]) out_v[start[segof[rloc[j]]]++] = t2.vert[j];
+  }
+  std::free(rloc);
+  std::free(segof);
+  std::free(start);
+  out_counts[0] = count;
+  out_counts[1] = nseg;
+  return 0;
+}
+
+// Restore from a checkpointed vertex_of array (vertex_of[cid] = global
+// id, -1 for unassigned): count resumes past the highest recorded cid;
+// holes stay dead. Returns 0, or -4 on allocation failure.
+int compact_session_rebuild(void* h, const int32_t* vertex_of, int32_t m) {
+  CompactSession* s = static_cast<CompactSession*>(h);
+  int32_t hi = -1;
+  for (int32_t c = 0; c < m && c < s->capacity; ++c) {
+    s->vert_of[c] = vertex_of[c];
+    if (vertex_of[c] >= 0) hi = c;
+  }
+  s->count = hi + 1;
+  int64_t tsize = s->tsize;
+  while (2 * static_cast<int64_t>(s->count) >= tsize) tsize *= 2;
+  if (!cs_rehash(s, tsize)) return -4;
+  return 0;
 }
 
 }  // extern "C"

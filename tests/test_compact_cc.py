@@ -200,6 +200,56 @@ def test_compact_matches_sparse_plan():
     assert np.array_equal(lab_c, lab_s)
 
 
+def test_compact_wire_formats_agree():
+    """The segment wire (fused native unit codec, round 5) and the pairs
+    wire (per-chunk combine + (v, ri) rows) must emit identical labels —
+    and both must match the numpy oracle — across batched windows."""
+    from gelly_tpu.library.connected_components import (
+        connected_components_compact,
+    )
+
+    src, dst = _rand_edges(seed=23)
+    oracle = cc_labels_numpy(src.astype(np.int32), dst.astype(np.int32),
+                             None, N_V)
+    m1 = mesh_lib.make_mesh(1)
+    labs = {}
+    for wire in ("segments", "pairs"):
+        agg = connected_components_compact(
+            N_V, compact_capacity=N_V, wire=wire
+        )
+        labs[wire] = np.asarray(
+            _stream(src, dst).aggregate(
+                agg, mesh=m1, merge_every=4, fold_batch=2
+            ).result()
+        )
+    assert np.array_equal(labs["segments"], oracle)
+    assert np.array_equal(labs["pairs"], oracle)
+
+
+def test_unit_segments_root_first_invariant():
+    """Wire invariant the device fold relies on: each segment's FIRST
+    member is the component root (canonical min vertex), and lengths sum
+    to the member count."""
+    from gelly_tpu.utils import native
+
+    if not native.unit_segments_available():
+        import pytest
+
+        pytest.skip("native unit segment codec unavailable")
+    rng = np.random.default_rng(7)
+    src = (rng.zipf(1.3, 20000) % 3000).astype(np.int32)
+    dst = (rng.zipf(1.3, 20000) % 3000).astype(np.int32)
+    m, ln = native.cc_unit_forest_segments(src, dst, None, 3000, block=997)
+    assert int(ln.sum()) == m.shape[0]
+    starts = np.concatenate([[0], np.cumsum(ln)[:-1]])
+    seg_of = np.repeat(np.arange(ln.shape[0]), ln)
+    roots = m[starts]
+    # Root-first + canonical min: the root is the minimum of its segment.
+    mins = np.full(ln.shape[0], np.iinfo(np.int32).max)
+    np.minimum.at(mins, seg_of, m)
+    assert np.array_equal(roots, mins)
+
+
 def test_compact_rerun_same_agg_instance():
     # on_run_start must reset the session: a second run with the same agg
     # re-assigns ids from scratch (fresh device state needs fresh newv).
