@@ -298,15 +298,13 @@ def connected_components_compact(
         size = -(-max(len(payloads), 1) // groups)
         combined = []
         for i in range(0, len(payloads), size):
-            grp = payloads[i:i + size]
-            s = np.concatenate([p["src"] for p in grp])
-            d = np.concatenate([p["dst"] for p in grp])
-            va = np.concatenate([
-                np.asarray(p["valid"], np.uint8) for p in grp
-            ])
-            combined.append(native.cc_unit_forest_segments(
-                s, d, None if bool(va.all()) else va, n, block=unit_block,
-            ))
+            builder = native.UnitForestBuilder(n, block=unit_block)
+            for p in payloads[i:i + size]:
+                va = np.asarray(p["valid"])
+                builder.add(
+                    p["src"], p["dst"], None if bool(va.all()) else va
+                )
+            combined.append(builder.finish())
         # Stateful cid remap in STREAM order (one session probe pass per
         # member; order-preserving, so the segment structure carries
         # over to cid space unchanged).

@@ -177,6 +177,22 @@ def _load_combiner() -> ctypes.CDLL:
                 ctypes.c_int64, _i32p, ctypes.c_int64, _i32p,
                 ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
             ]
+            lib.cc_unit_begin.restype = ctypes.c_void_p
+            lib.cc_unit_begin.argtypes = []
+            lib.cc_unit_destroy.restype = None
+            lib.cc_unit_destroy.argtypes = [ctypes.c_void_p]
+            lib.cc_unit_members.restype = ctypes.c_int64
+            lib.cc_unit_members.argtypes = [ctypes.c_void_p]
+            lib.cc_unit_add.restype = ctypes.c_int
+            lib.cc_unit_add.argtypes = [
+                ctypes.c_void_p, _i32p, _i32p, _u8p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int64,
+            ]
+            lib.cc_unit_finish.restype = ctypes.c_int
+            lib.cc_unit_finish.argtypes = [
+                ctypes.c_void_p, _i32p, ctypes.c_int64, _i32p,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ]
             lib._has_unit_segments = True
         except AttributeError:
             lib._has_unit_segments = False
@@ -502,6 +518,58 @@ def cc_unit_forest_segments(src: np.ndarray, dst: np.ndarray,
     )
     _sparse_rc_check(rc, "cc_unit_forest_segments")
     return out_v[: counts[0]], out_len[: counts[1]]
+
+
+class UnitForestBuilder:
+    """Streaming form of :func:`cc_unit_forest_segments`: ``add`` each
+    chunk's buffers as they arrive (no host-side concatenation of the
+    unit's edges — the measured concat was ~20% of the fused combine),
+    then ``finish`` sizes the output EXACTLY from the interned member
+    count. One builder per unit; not thread-safe."""
+
+    def __init__(self, n_v: int, block: int = 1 << 18):
+        self._lib = _load_combiner()
+        self._n_v = int(n_v)
+        self._block = int(block)
+        self._h = self._lib.cc_unit_begin()
+        if not self._h:
+            raise MemoryError("cc_unit_begin failed")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.cc_unit_destroy(h)
+            self._h = None
+
+    def add(self, src: np.ndarray, dst: np.ndarray,
+            valid: np.ndarray | None) -> None:
+        src = np.ascontiguousarray(src, np.int32)
+        dst = np.ascontiguousarray(dst, np.int32)
+        vp = None
+        if valid is not None:
+            valid = np.ascontiguousarray(valid, np.uint8)
+            vp = valid.ctypes.data_as(_u8p)
+        rc = self._lib.cc_unit_add(
+            self._h, _as_i32p(src), _as_i32p(dst), vp, src.shape[0],
+            self._n_v, self._block,
+        )
+        _sparse_rc_check(rc, "cc_unit_add")
+
+    def finish(self):
+        """(members, lengths) — root-first segment format; consumes the
+        builder."""
+        count = int(self._lib.cc_unit_members(self._h))
+        out_v = np.empty((count,), np.int32)
+        out_len = np.empty((count,), np.int32)
+        counts = np.zeros((2,), np.int64)
+        rc = self._lib.cc_unit_finish(
+            self._h, _as_i32p(out_v), count, _as_i32p(out_len), count,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        _sparse_rc_check(rc, "cc_unit_finish")
+        self._lib.cc_unit_destroy(self._h)
+        self._h = None
+        return out_v[: counts[0]], out_len[: counts[1]]
 
 
 class NativeCompactSession:

@@ -691,29 +691,35 @@ int64_t compact_session_lookup(void* h, const int32_t* ids, int64_t n,
 //
 // Returns 0, -2 on a slot outside [0, n_v), -3 on cap overflow, -4 on
 // allocation failure.
-int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
-                            const uint8_t* valid, int64_t n, int32_t n_v,
-                            int64_t block, int32_t* out_v, int64_t cap_v,
-                            int32_t* out_len, int64_t cap_len,
-                            int64_t* out_counts) {
-  out_counts[0] = 0;
-  out_counts[1] = 0;
+void* cc_unit_begin(void) {
+  GrowTable* t2 = new (std::nothrow) GrowTable();
+  if (!t2) return nullptr;
+  if (!t2->init(1 << 17)) { delete t2; return nullptr; }
+  return t2;
+}
+
+void cc_unit_destroy(void* h) {
+  delete static_cast<GrowTable*>(h);
+}
+
+int64_t cc_unit_members(void* h) {
+  return static_cast<GrowTable*>(h)->count;
+}
+
+// Fold one buffer of edges into the unit forest: cache-blocked level-1
+// union-find (per-block LocalTable + next-edge hash-slot prefetch), each
+// block's (vertex, root) pairs interned straight into the unit's growing
+// level-2 table. Callable repeatedly per unit — the caller streams its
+// chunk buffers without concatenating them.
+int cc_unit_add(void* h, const int32_t* src, const int32_t* dst,
+                const uint8_t* valid, int64_t n, int32_t n_v,
+                int64_t block) {
+  GrowTable* t2 = static_cast<GrowTable*>(h);
   if (block <= 0) block = 1 << 18;
-  // Level-1 pair scratch, grown geometrically (practical size ∝ touched
-  // vertices per block summed, far below the 2n worst case).
-  int64_t pcap = 1 << 16;
-  int64_t np_ = 0;
-  int32_t* pv = static_cast<int32_t*>(std::malloc(pcap * sizeof(int32_t)));
-  int32_t* pr = static_cast<int32_t*>(std::malloc(pcap * sizeof(int32_t)));
-  if (!pv || !pr) {
-    std::free(pv); std::free(pr);
-    return -4;
-  }
-  int rc = 0;
-  for (int64_t lo = 0; lo < n && rc == 0; lo += block) {
+  for (int64_t lo = 0; lo < n; lo += block) {
     const int64_t hi = lo + block < n ? lo + block : n;
     LocalTable t;
-    if (!t.init(hi - lo)) { rc = -4; break; }
+    if (!t.init(hi - lo)) return -4;
     for (int64_t i = lo; i < hi; ++i) {
       if (i + 8 < hi) {
         // Hide the table-probe latency of edge i+8 behind edge i's
@@ -728,7 +734,7 @@ int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
       if (valid != nullptr && !valid[i]) continue;
       const int32_t u = src[i];
       const int32_t v = dst[i];
-      if (u < 0 || u >= n_v || v < 0 || v >= n_v) { rc = -2; break; }
+      if (u < 0 || u >= n_v || v < 0 || v >= n_v) return -2;
       const int32_t lu = t.intern(u);
       const int32_t lv = t.intern(v);
       const int32_t ru = find_root(t.parent, lu);
@@ -738,46 +744,33 @@ int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
         else t.parent[ru] = rv;
       }
     }
-    if (rc) break;
-    if (np_ + t.count > pcap) {
-      while (np_ + t.count > pcap) pcap *= 2;
-      int32_t* nv2 = static_cast<int32_t*>(
-          std::realloc(pv, pcap * sizeof(int32_t)));
-      if (nv2) pv = nv2;
-      int32_t* nr2 = static_cast<int32_t*>(
-          std::realloc(pr, pcap * sizeof(int32_t)));
-      if (nr2) pr = nr2;
-      if (!nv2 || !nr2) { rc = -4; break; }
-    }
     for (int32_t j = 0; j < t.count; ++j) {
-      pv[np_] = t.vert[j];
-      pr[np_] = t.vert[find_root(t.parent, j)];
-      ++np_;
+      const int32_t lu = t2->intern(t.vert[j]);
+      const int32_t lv = t2->intern(t.vert[find_root(t.parent, j)]);
+      if (lu < 0 || lv < 0) return -4;
+      const int32_t ru = find_root(t2->parent, lu);
+      const int32_t rv = find_root(t2->parent, lv);
+      if (ru != rv) {
+        if (t2->vert[ru] < t2->vert[rv]) t2->parent[rv] = ru;
+        else t2->parent[ru] = rv;
+      }
     }
   }
-  if (rc) { std::free(pv); std::free(pr); return rc; }
-  // Level 2: merge the per-block forests in a growing table.
-  GrowTable t2;
-  if (!t2.init(1 << 17)) { std::free(pv); std::free(pr); return -4; }
-  for (int64_t i = 0; i < np_; ++i) {
-    const int32_t lu = t2.intern(pv[i]);
-    const int32_t lv = t2.intern(pr[i]);
-    if (lu < 0 || lv < 0) { rc = -4; break; }
-    const int32_t ru = find_root(t2.parent, lu);
-    const int32_t rv = find_root(t2.parent, lv);
-    if (ru != rv) {
-      if (t2.vert[ru] < t2.vert[rv]) t2.parent[rv] = ru;
-      else t2.parent[ru] = rv;
-    }
-  }
-  std::free(pv);
-  std::free(pr);
-  if (rc) return rc;
-  const int32_t count = t2.count;
+  return 0;
+}
+
+// Emit the unit forest in segment format and leave the builder empty of
+// output obligations (the caller destroys it). Segments are numbered by
+// first-touch of their root; the root entry goes FIRST in its segment
+// (the device derives each pair's root-row index as its segment start).
+int cc_unit_finish(void* h, int32_t* out_v, int64_t cap_v,
+                   int32_t* out_len, int64_t cap_len,
+                   int64_t* out_counts) {
+  GrowTable* t2 = static_cast<GrowTable*>(h);
+  out_counts[0] = 0;
+  out_counts[1] = 0;
+  const int32_t count = t2->count;
   if (count > cap_v) return -3;
-  // Segment assembly: segments numbered by first-touch of their root;
-  // the root entry goes FIRST in its segment (the device derives each
-  // pair's root-row index as its segment start).
   int32_t* rloc = static_cast<int32_t*>(std::malloc(
       sizeof(int32_t) * (count > 0 ? count : 1)));
   int32_t* segof = static_cast<int32_t*>(std::malloc(
@@ -786,7 +779,7 @@ int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
   std::memset(segof, 0xff, sizeof(int32_t) * (count > 0 ? count : 1));
   int32_t nseg = 0;
   for (int32_t j = 0; j < count; ++j) {
-    rloc[j] = find_root(t2.parent, j);
+    rloc[j] = find_root(t2->parent, j);
     if (segof[rloc[j]] < 0) {
       if (nseg >= cap_len) { std::free(rloc); std::free(segof); return -3; }
       segof[rloc[j]] = nseg++;
@@ -807,11 +800,11 @@ int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
   // Two-pass fill: roots at their segment starts first, then members
   // appended from start+1 onward (start[] doubles as the fill cursor).
   for (int32_t j = 0; j < count; ++j) {
-    if (j == rloc[j]) out_v[start[segof[j]]] = t2.vert[j];
+    if (j == rloc[j]) out_v[start[segof[j]]] = t2->vert[j];
   }
   for (int32_t s = 0; s < nseg; ++s) start[s] += 1;
   for (int32_t j = 0; j < count; ++j) {
-    if (j != rloc[j]) out_v[start[segof[rloc[j]]]++] = t2.vert[j];
+    if (j != rloc[j]) out_v[start[segof[rloc[j]]]++] = t2->vert[j];
   }
   std::free(rloc);
   std::free(segof);
@@ -819,6 +812,21 @@ int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
   out_counts[0] = count;
   out_counts[1] = nseg;
   return 0;
+}
+
+// One-shot convenience wrapper over begin/add/finish (single buffer).
+int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
+                            const uint8_t* valid, int64_t n, int32_t n_v,
+                            int64_t block, int32_t* out_v, int64_t cap_v,
+                            int32_t* out_len, int64_t cap_len,
+                            int64_t* out_counts) {
+  void* h = cc_unit_begin();
+  if (!h) return -4;
+  int rc = cc_unit_add(h, src, dst, valid, n, n_v, block);
+  if (rc == 0) rc = cc_unit_finish(h, out_v, cap_v, out_len, cap_len,
+                                   out_counts);
+  cc_unit_destroy(h);
+  return rc;
 }
 
 // Restore from a checkpointed vertex_of array (vertex_of[cid] = global
