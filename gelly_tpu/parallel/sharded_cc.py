@@ -135,7 +135,7 @@ def _chase_sharded(parent_loc, x, valid, num_shards, bucket_capacity):
     return x, drops
 
 
-def _fold_pairs_body(parent_loc, seen_loc, a, b, ok, num_shards,
+def _fold_pairs_body(parent_loc, seen_loc, dirty_loc, a, b, ok, num_shards,
                      bucket_capacity):
     """One shard's view of the pair fold (runs inside shard_map)."""
     per = parent_loc.shape[0]
@@ -151,11 +151,11 @@ def _fold_pairs_body(parent_loc, seen_loc, a, b, ok, num_shards,
         ].set(True, mode="drop")
 
     def cond(st):
-        _, live_any, _ = st
+        _, _, live_any, _ = st
         return live_any
 
     def body(st):
-        p_loc, _, drops = st
+        p_loc, dirty, _, drops = st
         ra, d1 = _chase_sharded(p_loc, a, ok, num_shards, bucket_capacity)
         rb, d2 = _chase_sharded(p_loc, b, ok, num_shards, bucket_capacity)
         lo = jnp.minimum(ra, rb)
@@ -175,13 +175,18 @@ def _fold_pairs_body(parent_loc, seen_loc, a, b, ok, num_shards,
             + jax.lax.axis_index(SHARD_AXIS)
         )
         p2 = jnp.where(is_root, jnp.minimum(p_loc, upd), p_loc)
+        # Dirty = entries whose parent changed since the last emission:
+        # the incremental labels() resolves ONLY these against the host
+        # root cache instead of re-flattening the whole forest.
+        dirty = dirty | (p2 != p_loc)
         live_any = jax.lax.psum(jnp.sum(live), SHARD_AXIS) > 0
-        return p2, live_any, drops + d1 + d2 + d3
+        return p2, dirty, live_any, drops + d1 + d2 + d3
 
-    parent_loc, _, drops = jax.lax.while_loop(
-        cond, body, (parent_loc, jnp.bool_(True), jnp.int64(0))
+    parent_loc, dirty_loc, _, drops = jax.lax.while_loop(
+        cond, body,
+        (parent_loc, dirty_loc, jnp.bool_(True), jnp.int64(0)),
     )
-    return parent_loc, seen_loc, drops
+    return parent_loc, seen_loc, dirty_loc, drops
 
 
 class ShardedCC:
@@ -206,18 +211,23 @@ class ShardedCC:
         S, per = self.S, self.per
 
         # Striped init: device d's local slot j is global slot j*S + d.
-        @partial(jax.jit, out_shardings=(sharded, sharded))
+        @partial(jax.jit, out_shardings=(sharded, sharded, sharded))
         def init():
             def body():
                 me = jax.lax.axis_index(SHARD_AXIS)
                 g = jnp.arange(per, dtype=jnp.int32) * S + me
-                return g[None], jnp.zeros((1, per), bool)
+                return (g[None], jnp.zeros((1, per), bool),
+                        jnp.zeros((1, per), bool))
 
             return mesh_lib.shard_map_fn(
-                self.mesh, body, in_specs=(), out_specs=(P(SHARD_AXIS),) * 2,
+                self.mesh, body, in_specs=(), out_specs=(P(SHARD_AXIS),) * 3,
             )()
 
-        self.parent, self.seen = init()
+        self.parent, self.seen, self.dirty = init()
+        # Host root cache: flat labels as of the last emission (identity
+        # at start — every slot its own root, matching the striped init).
+        # labels() resolves only the DIRTY parent entries against it.
+        self._rootcache = np.arange(vertex_capacity, dtype=np.int32)
         self._fold_fn = None
 
     def _bucket(self, L: int) -> int:
@@ -267,54 +277,81 @@ class ShardedCC:
         if self._fold_fn is None or self._fold_fn[0] != key:
             from jax.sharding import PartitionSpec as P2
 
-            @partial(jax.jit, out_shardings=(sharded, sharded, None))
-            def fold_fn(parent, seen, a_, b_, ok_):
-                def body(p, s, aa, bb, oo):
-                    p2, s2, drops = _fold_pairs_body(
-                        p[0], s[0], aa[0], bb[0], oo[0], S, cap
+            @partial(jax.jit,
+                     out_shardings=(sharded, sharded, sharded, None))
+            def fold_fn(parent, seen, dirty, a_, b_, ok_):
+                def body(p, s, dd, aa, bb, oo):
+                    p2, s2, d2, drops = _fold_pairs_body(
+                        p[0], s[0], dd[0], aa[0], bb[0], oo[0], S, cap
                     )
-                    return p2[None], s2[None], drops
+                    return p2[None], s2[None], d2[None], drops
 
-                p2, s2, drops = mesh_lib.shard_map_fn(
+                p2, s2, d2, drops = mesh_lib.shard_map_fn(
                     self.mesh, body,
-                    in_specs=(P2(SHARD_AXIS),) * 5,
-                    out_specs=(P2(SHARD_AXIS), P2(SHARD_AXIS), P2()),
-                )(parent, seen, a_, b_, ok_)
-                return p2, s2, jnp.sum(drops)
+                    in_specs=(P2(SHARD_AXIS),) * 6,
+                    out_specs=(P2(SHARD_AXIS), P2(SHARD_AXIS),
+                               P2(SHARD_AXIS), P2()),
+                )(parent, seen, dirty, a_, b_, ok_)
+                return p2, s2, d2, jnp.sum(drops)
 
             self._fold_fn = (key, fold_fn)
-        self.parent, self.seen, drops = self._fold_fn[1](
-            self.parent, self.seen, av, bv, okv
+        self.parent, self.seen, self.dirty, drops = self._fold_fn[1](
+            self.parent, self.seen, self.dirty, av, bv, okv
         )
         self.stats["dropped"] += int(drops)
 
     def labels(self) -> np.ndarray:
         """Emit global labels i32[capacity] (the window close).
 
-        Emission is inherently ∝ capacity (the output array is), so the
-        flatten runs on the HOST over the pulled stripes — vectorized
-        pointer jumping in global slot space — and the flattened parent is
-        pushed back so later folds chase depth-1 state. Fold/merge cost
-        stays ∝ pairs; only this emission pass touches full capacity
-        (the same once-per-window contract as the compact plan's
-        transform).
+        INCREMENTAL (VERDICT r4 item 3 — r4's emission re-flattened the
+        whole forest on the host, costing MORE than the folds at 8.4M):
+        folds mark the parent entries they change (``dirty``, add-only
+        hooks at true roots), and emission resolves ONLY those against
+        the host root cache of the previous emission:
+
+        1. pull the dirty (slot, parent) entries — ∝ hooks since the last
+           emission, never capacity;
+        2. chase the delta chains among themselves (a fixpoint over the
+           dirty entries only: every hook target was itself a root at the
+           last emission, so the cache answers non-dirty lookups in O(1));
+        3. ONE full-capacity gather maps every slot's cached root through
+           the resolved delta — the only O(capacity) work, and it is the
+           emission's output size anyway.
+
+        The device forest is never re-flattened or pushed back; the fold's
+        pointer chase absorbs the (slowly growing, ~1 level per window)
+        chain depth.
         """
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         S = self.S
-        flat = unstripe(np.asarray(self.parent).reshape(-1), S)
-        while True:
-            nxt = flat[flat]
-            if np.array_equal(nxt, flat):
-                break
-            flat = nxt
+        par = np.asarray(self.parent)  # [S, per]
+        dirty = np.asarray(self.dirty)  # [S, per]
+        sg, sl = np.nonzero(dirty)  # ∝ hooks since last emission
+        g = (sl * S + sg).astype(np.int32)
+        rc = self._rootcache
+        tmp = rc.copy()
+        tmp[g] = par[sg, sl]
+        if g.size:
+            # Delta-chain fixpoint over the dirty entries only: chains
+            # run root→newer-root, and any non-dirty target r satisfies
+            # tmp[r] == r (roots only ever stop being roots).
+            cur = tmp[g]
+            while True:
+                nxt = tmp[cur]
+                if np.array_equal(nxt, cur):
+                    break
+                cur = nxt
+            tmp[g] = cur
+        # One O(capacity) gather: new root of s = resolved(old root of s).
+        flat = tmp[rc]
+        self._rootcache = flat
+        if g.size:
+            self.dirty = jax.device_put(
+                np.zeros((S, self.per), bool),
+                NamedSharding(self.mesh, P(SHARD_AXIS)),
+            )
         seen = unstripe(np.asarray(self.seen).reshape(-1), S)
-        # Push the flattened forest back (re-stripe): keeps device-side
-        # chase depth at 1 for the next window's folds.
-        restriped = flat.reshape(self.per, S).T.copy()
-        self.parent = jax.device_put(
-            restriped, NamedSharding(self.mesh, P(SHARD_AXIS))
-        )
         return np.where(seen, flat, -1).astype(np.int32)
 
     def per_device_state_bytes(self) -> int:
