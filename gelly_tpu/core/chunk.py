@@ -233,3 +233,30 @@ def concat_chunks(a: EdgeChunk, b: EdgeChunk) -> EdgeChunk:
     Host chunks concatenate in numpy (no device round-trip)."""
     xp = np if a.is_host() and b.is_host() else jnp
     return EdgeChunk(*(xp.concatenate([x, y], axis=0) for x, y in zip(a, b)))
+
+
+def split_chunk_host(chunk: EdgeChunk, parts: int) -> list[EdgeChunk]:
+    """Split a HOST chunk into ``parts`` contiguous slices along the edge
+    axis (padding the tail with invalid entries when the capacity is not
+    divisible) — the host-side analog of ``parallel.partition.split_chunk``
+    for staging paths that compress before the H2D transfer (the mesh
+    windowed codec). Slices are views where no padding is needed."""
+    n = np.asarray(chunk.src).shape[0]
+    per = -(-max(n, parts) // parts)
+    pad = per * parts - n
+
+    def prep(name, a):
+        a = np.asarray(a)
+        if pad:
+            fill = np.zeros((pad,) + a.shape[1:], a.dtype)
+            a = np.concatenate([a, fill])
+        return a
+
+    fields = {name: prep(name, getattr(chunk, name))
+              for name in chunk._fields}
+    return [
+        EdgeChunk(**{
+            k: v[s * per:(s + 1) * per] for k, v in fields.items()
+        })
+        for s in range(parts)
+    ]

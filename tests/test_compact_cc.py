@@ -406,6 +406,53 @@ def test_windowed_codec_degrees_parity():
             assert np.array_equal(g, r), (codec, i)
 
 
+def test_mesh_windowed_codec_parity():
+    """VERDICT r4 item 5: window_ms + codec + S>1 — the masked chunk
+    splits into S host slices whose payloads ride the sharded batch axis.
+    Per-window emissions on the 8-device mesh must equal the single-shard
+    windowed run for the sparse AND compact codecs, and for the degree
+    codec."""
+    from gelly_tpu.core.io import EdgeChunkSource, TimeCharacteristic
+    from gelly_tpu.library.degrees import degree_aggregate
+
+    rng = np.random.default_rng(29)
+    n = 1200
+    src = (rng.zipf(1.4, n) % N_V).astype(np.int64)
+    dst = (rng.zipf(1.4, n) % N_V).astype(np.int64)
+    ts = np.sort(rng.integers(0, 400, n)).astype(np.int64)
+
+    def stream():
+        return edge_stream_from_source(
+            EdgeChunkSource(src, dst, timestamps=ts, chunk_size=128,
+                            table=IdentityVertexTable(N_V),
+                            time=TimeCharacteristic.EVENT),
+            N_V,
+        )
+
+    m1 = mesh_lib.make_mesh(1)
+    m8 = mesh_lib.make_mesh()
+
+    def run(agg, mesh):
+        return [
+            np.asarray(e)
+            for e in stream().aggregate(agg, mesh=mesh, window_ms=100)
+        ]
+
+    for make in (
+        lambda: connected_components(N_V, codec="sparse", merge="gather"),
+        lambda: connected_components(
+            N_V, codec="compact", compact_capacity=N_V
+        ),
+        lambda: degree_aggregate(N_V, codec="sparse"),
+    ):
+        single = run(make(), m1)
+        mesh = run(make(), m8)
+        assert len(single) >= 3
+        assert len(single) == len(mesh)
+        for i, (a, b) in enumerate(zip(single, mesh)):
+            assert np.array_equal(a, b), (make, i)
+
+
 def test_compact_requires_codec_path():
     agg = connected_components(N_V, codec="compact", compact_capacity=N_V)
     with pytest.raises(NotImplementedError):
