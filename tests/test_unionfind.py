@@ -257,3 +257,79 @@ def test_union_pairs_star_sequential_calls_fuzz():
         got = labels_of(p, m)
         want = _pair_oracle(m, all_pairs)
         assert got == want, (seed, got, want)
+
+
+# ------------------- sort-dedup raw fold (round 5) -------------------- #
+
+
+def test_union_edges_dedup_matches_union_edges():
+    from gelly_tpu.ops.unionfind import union_edges_dedup
+
+    rng = np.random.default_rng(12)
+    n = 256
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        src = rng.integers(0, n, 500).astype(np.int32)
+        dst = rng.integers(0, n, 500).astype(np.int32)
+        valid = rng.random(500) < 0.85
+        p1 = union_edges(
+            fresh_forest(n), jnp.asarray(src), jnp.asarray(dst),
+            jnp.asarray(valid),
+        )
+        p2 = union_edges_dedup(
+            fresh_forest(n), jnp.asarray(src), jnp.asarray(dst),
+            jnp.asarray(valid), unique_cap=256, tail_cap=64,
+        )
+        assert labels_of(p1, n) == labels_of(p2, n), seed
+
+
+def test_union_edges_dedup_cap_overflow_exact():
+    # ALL pairs distinct and unique_cap tiny: the full-width exact
+    # fallback must fire and still produce correct labels.
+    from gelly_tpu.ops.unionfind import union_edges_dedup
+
+    n = 128
+    src = np.arange(0, 126, 2, dtype=np.int32)
+    dst = (np.arange(0, 126, 2, dtype=np.int32) + 1)
+    p = union_edges_dedup(
+        fresh_forest(n), jnp.asarray(src), jnp.asarray(dst),
+        jnp.ones(src.shape[0], bool), unique_cap=8, tail_cap=4,
+    )
+    lab = labels_of(p, 126)
+    assert lab == [2 * (i // 2) for i in range(126)]
+
+
+def test_union_edges_dedup_tail_overflow_exact():
+    # Long chain: the depth-3 rounds leave most pairs unresolved, the
+    # tail cap overflows, and the exact distinct-pair fallback finishes.
+    from gelly_tpu.ops.unionfind import union_edges_dedup
+
+    n = 128
+    src = np.arange(0, 100, dtype=np.int32)
+    dst = np.arange(1, 101, dtype=np.int32)
+    p = union_edges_dedup(
+        fresh_forest(n), jnp.asarray(src), jnp.asarray(dst),
+        jnp.ones(100, bool), unique_cap=128, tail_cap=4,
+    )
+    assert labels_of(p, 101) == [0] * 101
+
+
+def test_union_edges_dedup_sequential_folds():
+    # Streaming shape: repeated folds into the same forest, components
+    # lowered across folds, parity vs the generic kernel every step.
+    from gelly_tpu.ops.unionfind import union_edges_dedup
+
+    n = 512
+    rng = np.random.default_rng(33)
+    p1 = fresh_forest(n)
+    p2 = fresh_forest(n)
+    for step in range(5):
+        src = (rng.zipf(1.5, 300) % n).astype(np.int32)
+        dst = (rng.zipf(1.5, 300) % n).astype(np.int32)
+        ok = jnp.ones(300, bool)
+        p1 = union_edges(p1, jnp.asarray(src), jnp.asarray(dst), ok)
+        p2 = union_edges_dedup(
+            p2, jnp.asarray(src), jnp.asarray(dst), ok,
+            unique_cap=256, tail_cap=64,
+        )
+        assert labels_of(p1, n) == labels_of(p2, n), step
