@@ -21,19 +21,19 @@ the spanner *properties* instead (subset of input; per-edge stretch ≤ k;
 connectivity preserved), the approach the reference's own unit test takes
 scenario-wise (``T/util/AdjacencyListGraphTest.java:57-87``).
 
-WHICH PATH TO USE: the production path is :class:`HostSpannerStream` (the
-native C++ bounded-BFS stage, multi-M edges/s, exact-parity-tested) —
-like the reference's op, the fold is a strictly sequential scalar state
-machine, the worst shape for an accelerator. The device aggregates in
-this module (``spanner_aggregation`` / ``sparse_spanner``) exist for the
-engine-plumbed mesh/combine semantics and for small streams; at measured
-4.9k edges/s (dense) / 0.4k edges/s (sparse) they are NOT peer options at
-scale. The sparse CROSS-PARTITION combine, however, batch-gates the
-donor's edges (:func:`_sparse_insert_edges_batched`): 64 vmapped
-bounded-BFS gates per round, a while_loop that stops at the donor's
-actual edge count — combine cost ∝ accepted edges, usable at the N ≥ 1M
-the sparse summary targets (the per-edge FOLD remains the host stage's
-job).
+WHICH PATH TO USE: the order-exact production path is
+:class:`HostSpannerStream` (the native C++ bounded-BFS stage, multi-M
+edges/s, exact-parity-tested) — like the reference's op, the sequential
+gate is a scalar state machine. For k == 2 the device is now a peer
+option (round 5): ``gate_batch`` switches the sparse fold to the batched
+closed-form distance-2 gate (:func:`_sparse_fold_chunk_k2`, one D x D
+row intersection per candidate) — measured **~2M edges/s at n_v = 2^20**
+on v5e (vs ~5k for the per-edge BFS scan), with conservative-acceptance
+semantics (extra edges possible, stretch bound never broken). For
+general k the device aggregates remain semantics/combine plumbing: the
+per-edge BFS fold runs ~5k edges/s (dense) and the sparse
+CROSS-PARTITION combine batch-gates the donor's edges
+(:func:`_sparse_insert_edges_batched`) at cost ∝ accepted edges.
 """
 
 from __future__ import annotations
