@@ -556,7 +556,10 @@ def run_aggregation(
         # explicitly (the explicit value is honored unbounded).
         ingest_workers = min(available_cores(), 8)
     if prefetch_depth is None:
-        prefetch_depth = max(2, min(ingest_workers, 8))
+        # Defaults track the (already-capped) worker count; an EXPLICIT
+        # ingest_workers above the default cap gets the matching depth —
+        # capping here too would permanently idle the extra workers.
+        prefetch_depth = max(2, ingest_workers)
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)
     plan = _compiled_plan(agg, m)

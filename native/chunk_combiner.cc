@@ -491,17 +491,21 @@ struct GrowTable {
   }
 
   bool grow() {
-    tsize *= 2;
-    mask = tsize - 1;
-    int32_t* t = static_cast<int32_t*>(std::malloc(tsize * sizeof(int32_t)));
+    // Mutate members only after every allocation succeeds: a half-grown
+    // state (doubled mask, old table) would make any later intern()
+    // probe out of bounds if the caller retries after an OOM failure.
+    const int64_t nsize = tsize * 2;
+    int32_t* t = static_cast<int32_t*>(std::malloc(nsize * sizeof(int32_t)));
     int32_t* v2 = static_cast<int32_t*>(
-        std::realloc(vert, tsize / 2 * sizeof(int32_t)));
+        std::realloc(vert, nsize / 2 * sizeof(int32_t)));
     if (v2) vert = v2;
     int32_t* p2 = static_cast<int32_t*>(
-        std::realloc(parent, tsize / 2 * sizeof(int32_t)));
+        std::realloc(parent, nsize / 2 * sizeof(int32_t)));
     if (p2) parent = p2;
     if (!t || !v2 || !p2) { std::free(t); return false; }
-    std::memset(t, 0xff, tsize * sizeof(int32_t));
+    tsize = nsize;
+    mask = nsize - 1;
+    std::memset(t, 0xff, nsize * sizeof(int32_t));
     for (int32_t c = 0; c < count; ++c) {
       int64_t i = cs_hash(vert[c], mask);
       while (t[i] >= 0) i = (i + 1) & mask;
