@@ -1721,7 +1721,7 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--workload", default="all",
                    choices=["all", "cc", "cc_large", "degrees", "triangles",
-                            "bipartiteness", "matching"])
+                            "bipartiteness", "matching", "spanner"])
     p.add_argument("--edges", type=int, default=64_000_000)
     p.add_argument("--vertices", type=int, default=1 << 17)
     p.add_argument("--chunk-size", type=int, default=1 << 23)
@@ -1751,6 +1751,9 @@ def main() -> int:
     small.chunk_size = min(args.chunk_size, 1 << 18)
     small.merge_every = 8
 
+    if args.workload == "spanner":
+        print(json.dumps(bench_spanner(args)))
+        return 0
     if args.workload == "cc":
         print(json.dumps(bench_cc(args)))
         return 0
