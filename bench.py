@@ -421,7 +421,8 @@ def device_bound_cc_eps(src, dst, n_v: int, chunk_size: int,
         ok = jnp.ones(cs.shape, bool)
         if chunk_size >= RAW_DEDUP_MIN_CHUNK:
             parent = unionfind.union_edges_dedup(
-                parent, cs, cd, ok, unique_cap=max(1 << 20, chunk_size // 4)
+                parent, cs, cd, ok,
+                unique_cap=max(1 << 20, 3 * (chunk_size >> 4)),
             )
         else:
             parent = unionfind.union_edges(parent, cs, cd, ok)
