@@ -437,6 +437,15 @@ def device_bound_cc_eps(src, dst, n_v: int, chunk_size: int,
     staged = _stage_raw_chunks(src, dst, chunk_size, max_edges)
     eps = _device_bound_eps(fold_chunk, transform, init, staged, chunk_size)
     if parity_out is not None:
+        # Decomposition (same method as the MFU split): the timed program
+        # includes the per-window full-capacity label transform; timing
+        # the folds alone separates the kernel's rate from the
+        # once-per-window transform share.
+        eps_folds = _device_bound_eps(
+            fold_chunk, lambda st: (st[0][:8], st[1][:8]),
+            init, staged, chunk_size,
+        )
+        parity_out["device_fold_no_transform_eps"] = round(eps_folds, 1)
         import jax
 
         from gelly_tpu.library.connected_components import (
