@@ -543,6 +543,10 @@ class UnitForestBuilder:
 
     def add(self, src: np.ndarray, dst: np.ndarray,
             valid: np.ndarray | None) -> None:
+        if not self._h:
+            raise RuntimeError(
+                "UnitForestBuilder already finished; create a new one"
+            )
         src = np.ascontiguousarray(src, np.int32)
         dst = np.ascontiguousarray(dst, np.int32)
         vp = None
@@ -558,6 +562,10 @@ class UnitForestBuilder:
     def finish(self):
         """(members, lengths) — root-first segment format; consumes the
         builder."""
+        if not self._h:
+            raise RuntimeError(
+                "UnitForestBuilder already finished; create a new one"
+            )
         count = int(self._lib.cc_unit_members(self._h))
         out_v = np.empty((count,), np.int32)
         out_len = np.empty((count,), np.int32)
