@@ -1,0 +1,50 @@
+"""Repo-specific static analysis for the hybrid native/JAX runtime.
+
+The framework re-owns runtime responsibilities Flink provided for free:
+a ctypes-bound C++ ingest layer (``native/*.cc`` + ``utils/native.py``)
+and jitted fixed-shape fold pipelines. Both fail *silently*: an
+``argtypes`` declaration drifting from its ``extern "C"`` signature
+corrupts memory instead of raising, and a host-side numpy call or
+data-dependent Python branch inside a jitted step recompiles or breaks
+on TPU without failing on the CPU tier-1 lane. This package is the
+correctness-tooling floor under both:
+
+- :mod:`gelly_tpu.analysis.abi` — cross-checks every ``extern "C"``
+  declaration in ``native/*.cc`` against the ``argtypes``/``restype``
+  bindings in ``gelly_tpu/utils/native.py`` (rule ids ``AB0xx``);
+- :mod:`gelly_tpu.analysis.jitlint` — AST linter flagging jit hazards
+  inside ``jax.jit``-decorated functions and their one-level callees
+  (rule ids ``GL0xx``, inline ``# graphlint: disable=GLxxx``
+  suppression);
+- :mod:`gelly_tpu.analysis.sanitize` — builds the native components
+  under ASan/UBSan (``GELLY_NATIVE_SANITIZE=asan|ubsan``) and drives a
+  smoke workload through every fold in an ``LD_PRELOAD``-prepared
+  subprocess.
+
+Run everything with ``python -m gelly_tpu.analysis`` (exits non-zero on
+any unsuppressed finding). See ``--help`` for lane selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analysis finding, printable as ``path:line: RULE message``."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        s = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+__all__ = ["Finding"]

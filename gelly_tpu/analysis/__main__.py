@@ -1,0 +1,129 @@
+"""CLI: ``python -m gelly_tpu.analysis``.
+
+Runs the ABI cross-checker and the jit-hazard linter over the repo (and
+optionally the sanitizer smoke lane), printing findings as
+``path:line: RULE message`` and exiting non-zero on any unsuppressed
+finding. This is the gate every PR inherits (.github/workflows/
+analysis.yml); run it locally before pushing native or jit changes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import Finding
+from . import abi as abi_mod
+from . import jitlint as jitlint_mod
+from . import sanitize as sanitize_mod
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _list_rules() -> str:
+    lines = ["ABI cross-checker (analysis/abi.py):"]
+    for rid, desc in (
+        ("AB001", "native function has no ctypes binding"),
+        ("AB002", "binding names a symbol no extern \"C\" block declares"),
+        ("AB003", "parameter-count (arity) mismatch"),
+        ("AB004", "parameter type/width mismatch"),
+        ("AB005", "return type mismatch / missing restype or argtypes"),
+        ("AB006", "declaration or binding the checker cannot resolve"),
+    ):
+        lines.append(f"  {rid}  {desc}")
+    lines.append("jit-hazard linter (analysis/jitlint.py), suppress with "
+                 "`# graphlint: disable=GLxxx`:")
+    for rid, (summary, _hint) in sorted(jitlint_mod.RULES.items()):
+        lines.append(f"  {rid}  {summary}")
+    lines.append("sanitizer lane (analysis/sanitize.py): "
+                 "--sanitize asan|ubsan, env GELLY_NATIVE_SANITIZE")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gelly_tpu.analysis",
+        description="repo-specific static analysis: ABI cross-check of "
+                    "native/*.cc vs ctypes bindings, jit-hazard lint of "
+                    "gelly_tpu/, optional native sanitizer smoke lane",
+    )
+    ap.add_argument("--root", default=_REPO_ROOT,
+                    help="repo root (default: the checkout this package "
+                         "lives in)")
+    ap.add_argument("--native-dir", default=None,
+                    help="directory of *.cc sources (default ROOT/native)")
+    ap.add_argument("--bindings", default=None,
+                    help="ctypes bindings module (default "
+                         "ROOT/gelly_tpu/utils/native.py)")
+    ap.add_argument("--lint-path", action="append", default=None,
+                    metavar="PATH",
+                    help="file/dir to jit-lint (repeatable; default "
+                         "ROOT/gelly_tpu)")
+    ap.add_argument("--skip-abi", action="store_true",
+                    help="skip the ABI cross-checker")
+    ap.add_argument("--skip-jitlint", action="store_true",
+                    help="skip the jit-hazard linter")
+    ap.add_argument("--sanitize", choices=("asan", "ubsan", "both"),
+                    default=None,
+                    help="also run the native smoke workload under the "
+                         "given sanitizer(s) in an LD_PRELOAD subprocess")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every rule id and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    root = os.path.abspath(args.root)
+    native_dir = args.native_dir or os.path.join(root, "native")
+    bindings = args.bindings or os.path.join(
+        root, "gelly_tpu", "utils", "native.py")
+    lint_paths = args.lint_path or [os.path.join(root, "gelly_tpu")]
+
+    findings: list[Finding] = []
+    if not args.skip_abi:
+        findings += abi_mod.cross_check(native_dir, bindings)
+    if not args.skip_jitlint:
+        findings += jitlint_mod.lint_paths(root, lint_paths)
+
+    for f in findings:
+        print(f.render())
+
+    rc = 0
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        rc = 1
+
+    if args.sanitize:
+        modes = ("asan", "ubsan") if args.sanitize == "both" \
+            else (args.sanitize,)
+        for mode in modes:
+            if not sanitize_mod.sanitizer_available(mode):
+                print(f"sanitize[{mode}]: runtime unavailable "
+                      "(g++ or lib{a,ub}san missing) — skipped",
+                      file=sys.stderr)
+                continue
+            proc = sanitize_mod.run_smoke(mode)
+            if proc.returncode != 0:
+                print(f"sanitize[{mode}]: FAILED (rc={proc.returncode})",
+                      file=sys.stderr)
+                sys.stderr.write(proc.stdout[-2000:])
+                sys.stderr.write(proc.stderr[-4000:])
+                rc = 1
+            else:
+                print(proc.stdout.strip() or f"sanitize[{mode}]: clean")
+
+    if rc == 0:
+        checks = [c for c, skip in (("abi", args.skip_abi),
+                                    ("jitlint", args.skip_jitlint)) if not skip]
+        if args.sanitize:
+            checks.append(f"sanitize:{args.sanitize}")
+        print(f"analysis clean ({', '.join(checks)})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

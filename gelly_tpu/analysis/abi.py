@@ -1,0 +1,462 @@
+"""ABI cross-checker: ``extern "C"`` declarations vs ctypes bindings.
+
+The native ingest layer is bound by hand-written ``argtypes``/``restype``
+declarations in ``gelly_tpu/utils/native.py``. ctypes never verifies them
+against the compiled symbols, so a drifted binding (an added parameter,
+an ``int64_t*`` bound as ``POINTER(c_int32)``) silently corrupts memory
+instead of raising. This module parses both sides — a small C declaration
+parser over the ``extern "C"`` blocks (no libclang dependency) and an
+``ast`` walk over the Python bindings — reduces each type to a canonical
+width string (``i32``, ``i64*``, ``char*``, ``void``), and diffs them.
+
+Rules:
+
+- ``AB001`` native function has no ctypes binding
+- ``AB002`` binding names a symbol no ``extern "C"`` block declares
+- ``AB003`` parameter-count (arity) mismatch
+- ``AB004`` parameter type/width mismatch
+- ``AB005`` return type mismatch (or binding missing restype/argtypes)
+- ``AB006`` declaration or binding the checker cannot resolve
+
+Width canonicalization assumes the LP64 convention every supported
+platform (x86-64 / aarch64 Linux, TPU hosts) uses: C ``int`` is 32-bit,
+so ``ctypes.c_int`` and ``int32_t`` are the same wire type.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import glob
+import os
+import re
+
+from . import Finding
+
+# ------------------------------------------------------------------ #
+# C side: comment stripping, extern "C" extraction, declaration parsing
+
+_C_QUALIFIERS = {"const", "volatile", "restrict", "struct", "enum", "inline",
+                 "static", "extern", "register"}
+
+# Canonical width of a C base type, keyed by the sorted tuple of its
+# tokens (so "unsigned char" and "char unsigned" agree).
+_C_BASE = {
+    ("char",): "char",
+    ("char", "signed"): "i8",
+    ("int8_t",): "i8",
+    ("char", "unsigned"): "u8",
+    ("uint8_t",): "u8",
+    ("short",): "i16",
+    ("int", "short"): "i16",
+    ("int16_t",): "i16",
+    ("uint16_t",): "u16",
+    ("int",): "i32",
+    ("signed",): "i32",
+    ("int32_t",): "i32",
+    ("unsigned",): "u32",
+    ("int", "unsigned"): "u32",
+    ("uint32_t",): "u32",
+    ("int64_t",): "i64",
+    ("long", "long"): "i64",
+    ("int", "long", "long"): "i64",
+    ("uint64_t",): "u64",
+    ("long",): "long",      # platform-width: bind as c_long or not at all
+    ("int", "long"): "long",
+    ("long", "unsigned"): "ulong",
+    ("size_t",): "usize",
+    ("ssize_t",): "isize",
+    ("float",): "f32",
+    ("double",): "f64",
+    ("bool",): "bool",
+    ("void",): "void",
+}
+
+
+@dataclasses.dataclass
+class CDecl:
+    """One ``extern "C"`` function: canonical return + parameter types."""
+
+    name: str
+    ret: str
+    params: list  # list[str] canonical types
+    path: str
+    line: int
+
+
+def strip_comments(text: str) -> str:
+    """Blank out ``//`` and ``/* */`` comments (length-preserving, so
+    offsets map back to the raw file), leaving string/char literals in
+    place — a ``/*`` inside a literal is not a comment."""
+    out = list(text)
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                out[i] = " "
+                i += 1
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            while i < j:
+                if text[i] != "\n":
+                    out[i] = " "
+                i += 1
+        elif c in "\"'":
+            i = _skip_literal(text, i)
+        else:
+            i += 1
+    return "".join(out)
+
+
+def _skip_literal(text: str, i: int) -> int:
+    """Index just past the string/char literal starting at ``text[i]``."""
+    quote = text[i]
+    i += 1
+    n = len(text)
+    while i < n and text[i] != quote:
+        i += 2 if text[i] == "\\" else 1
+    return min(i + 1, n)
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+_TOKEN_RE = re.compile(r"[A-Za-z_]\w*|\*")
+
+
+def _canon_c_type(tokens: list[str], where: str):
+    """Canonical string of a C type token list, or None if unknown."""
+    stars = sum(1 for t in tokens if t == "*")
+    base = tuple(sorted(t for t in tokens
+                        if t != "*" and t not in _C_QUALIFIERS))
+    canon = _C_BASE.get(base)
+    if canon is None:
+        return None
+    return canon + "*" * stars
+
+
+def _parse_c_params(params_text: str, path: str, line: int):
+    """Canonical param types of one declaration; Findings for unknowns."""
+    params, findings = [], []
+    text = params_text.strip()
+    if text in ("", "void"):
+        return params, findings
+    depth = 0
+    parts, cur = [], []
+    for ch in text:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    for part in parts:
+        tokens = _TOKEN_RE.findall(part)
+        tokens = [t for t in tokens if t not in _C_QUALIFIERS]
+        # Trailing identifier that is not a type keyword = parameter name.
+        if (len(tokens) >= 2 and tokens[-1] != "*"
+                and (tokens[-1],) not in _C_BASE
+                and tokens[-1] not in ("long", "unsigned", "signed", "int")):
+            tokens = tokens[:-1]
+        canon = _canon_c_type(tokens, part)
+        if canon is None:
+            findings.append(Finding(
+                path, line, "AB006",
+                f"cannot canonicalize C parameter type {part.strip()!r}",
+            ))
+            canon = "?"
+        params.append(canon)
+    return params, findings
+
+
+def parse_extern_c(path: str):
+    """All ``extern "C"`` function declarations in one C++ source file.
+
+    Returns ``(decls, findings)``. Handles both prototypes (``...);``) and
+    definitions (``...) { body }``, bodies brace-matched and skipped).
+    """
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        raw = f.read()
+    text = strip_comments(raw)
+    decls: list[CDecl] = []
+    findings: list[Finding] = []
+    for m in re.finditer(r'extern\s+"C"\s*\{', text):
+        i = m.end()
+        depth = 1  # inside the extern block's brace
+        head_start = i
+        while i < len(text) and depth > 0:
+            ch = text[i]
+            if ch == "{":
+                # Function body (or aggregate): the accumulated head is a
+                # complete declarator. Parse it, then skip the body.
+                header = text[head_start:i]
+                d, fs = _parse_c_decl(header, path,
+                                      _line_of(text, head_start))
+                findings.extend(fs)
+                if d:
+                    decls.append(d)
+                body_depth = 1
+                i += 1
+                while i < len(text) and body_depth > 0:
+                    if text[i] in "\"'":
+                        i = _skip_literal(text, i)
+                        continue
+                    if text[i] == "{":
+                        body_depth += 1
+                    elif text[i] == "}":
+                        body_depth -= 1
+                    i += 1
+                head_start = i
+            elif ch == ";":
+                header = text[head_start:i]
+                d, fs = _parse_c_decl(header, path,
+                                      _line_of(text, head_start))
+                findings.extend(fs)
+                if d:
+                    decls.append(d)
+                i += 1
+                head_start = i
+            elif ch == "}":
+                depth -= 1
+                i += 1
+            else:
+                i += 1
+    return decls, findings
+
+
+def _parse_c_decl(header: str, path: str, line: int):
+    """Parse one declaration chunk; returns (CDecl | None, findings)."""
+    # Point the finding at the declaration's own first line: the chunk
+    # starts right after the previous declaration's terminator, so it
+    # leads with that line's remainder plus blank lines.
+    lead = len(header) - len(header.lstrip())
+    line += header[:lead].count("\n")
+    header = header.strip()
+    if "(" not in header or not header:
+        return None, []
+    # Skip the keyword soup of non-function statements (typedefs, using).
+    if header.startswith(("typedef", "using", "namespace", "#")):
+        return None, []
+    lp = header.index("(")
+    rp = header.rindex(")")
+    head_tokens = _TOKEN_RE.findall(header[:lp])
+    if len(head_tokens) < 2:
+        return None, []
+    name = head_tokens[-1]
+    findings: list[Finding] = []
+    ret = _canon_c_type(head_tokens[:-1], header)
+    if ret is None:
+        findings.append(Finding(
+            path, line, "AB006",
+            f"cannot canonicalize return type of {name!r}",
+        ))
+        ret = "?"
+    params, fs = _parse_c_params(header[lp + 1:rp], path, line)
+    findings.extend(fs)
+    return CDecl(name, ret, params, path, line), findings
+
+
+# ------------------------------------------------------------------ #
+# Python side: ast walk over the ctypes bindings
+
+_CTYPES_BASE = {
+    "c_int8": "i8", "c_byte": "i8",
+    "c_uint8": "u8", "c_ubyte": "u8",
+    "c_int16": "i16", "c_short": "i16",
+    "c_uint16": "u16", "c_ushort": "u16",
+    "c_int32": "i32", "c_int": "i32",       # LP64: int is 32-bit
+    "c_uint32": "u32", "c_uint": "u32",
+    "c_int64": "i64", "c_longlong": "i64",
+    "c_uint64": "u64", "c_ulonglong": "u64",
+    "c_long": "long", "c_ulong": "ulong",
+    "c_size_t": "usize", "c_ssize_t": "isize",
+    "c_float": "f32", "c_double": "f64",
+    "c_bool": "bool", "c_char": "char",
+    "c_char_p": "char*", "c_void_p": "void*",
+}
+
+
+@dataclasses.dataclass
+class Binding:
+    """ctypes declarations of one symbol found in the bindings module."""
+
+    name: str
+    restype: str | None = None
+    argtypes: list | None = None
+    line: int = 0
+
+
+def _resolve_ctype(node: ast.AST, env: dict):
+    """Canonical width string of a ctypes type expression, or None."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "void"
+    if isinstance(node, ast.Name):
+        return env.get(node.id) or _CTYPES_BASE.get(node.id)
+    if isinstance(node, ast.Attribute):
+        return _CTYPES_BASE.get(node.attr)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if fname == "POINTER" and len(node.args) == 1:
+            inner = _resolve_ctype(node.args[0], env)
+            return None if inner is None else inner + "*"
+    return None
+
+
+def parse_ctypes_bindings(path: str):
+    """All ``<lib>.<name>.argtypes/.restype`` assignments in a module.
+
+    Returns ``(bindings, findings)`` where bindings maps symbol name →
+    :class:`Binding`. Module-level aliases (``_i32p = ctypes.POINTER(...)``)
+    are resolved first so binding lists can use them.
+    """
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    env: dict = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            t = _resolve_ctype(node.value, env)
+            if t is not None:
+                env[node.targets[0].id] = t
+    bindings: dict[str, Binding] = {}
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and tgt.attr in ("restype", "argtypes")
+                and isinstance(tgt.value, ast.Attribute)):
+            continue
+        symbol = tgt.value.attr
+        b = bindings.setdefault(symbol, Binding(symbol, line=node.lineno))
+        if tgt.attr == "restype":
+            t = _resolve_ctype(node.value, env)
+            if t is None:
+                findings.append(Finding(
+                    path, node.lineno, "AB006",
+                    f"cannot resolve restype expression for {symbol!r}",
+                ))
+                t = "?"
+            b.restype = t
+        else:
+            if not isinstance(node.value, (ast.List, ast.Tuple)):
+                findings.append(Finding(
+                    path, node.lineno, "AB006",
+                    f"argtypes of {symbol!r} is not a literal list",
+                ))
+                continue
+            args = []
+            for elt in node.value.elts:
+                t = _resolve_ctype(elt, env)
+                if t is None:
+                    findings.append(Finding(
+                        path, node.lineno, "AB006",
+                        f"cannot resolve argtypes entry "
+                        f"{ast.unparse(elt)!r} for {symbol!r}",
+                    ))
+                    t = "?"
+                args.append(t)
+            b.argtypes = args
+            b.line = node.lineno
+    return bindings, findings
+
+
+# ------------------------------------------------------------------ #
+# the diff
+
+def _types_match(c_type: str, py_type: str) -> bool:
+    if "?" in (c_type, py_type):
+        return True  # already reported as AB006; don't double-report
+    return c_type == py_type
+
+
+def cross_check(native_dir: str, bindings_path: str) -> list[Finding]:
+    """Diff every ``extern "C"`` declaration under ``native_dir`` against
+    the ctypes bindings in ``bindings_path``."""
+    findings: list[Finding] = []
+    decls: dict[str, CDecl] = {}
+    for cc in sorted(glob.glob(os.path.join(native_dir, "*.cc"))):
+        ds, fs = parse_extern_c(cc)
+        findings.extend(fs)
+        for d in ds:
+            if d.name in decls:
+                findings.append(Finding(
+                    d.path, d.line, "AB006",
+                    f"duplicate extern \"C\" declaration of {d.name!r} "
+                    f"(also in {decls[d.name].path})",
+                ))
+            decls[d.name] = d
+    bindings, fs = parse_ctypes_bindings(bindings_path)
+    findings.extend(fs)
+
+    for name, d in sorted(decls.items()):
+        b = bindings.get(name)
+        if b is None:
+            findings.append(Finding(
+                d.path, d.line, "AB001",
+                f"extern \"C\" function {name!r} has no ctypes binding in "
+                f"{os.path.basename(bindings_path)}",
+                hint="declare argtypes/restype before first use, or drop "
+                     "the dead native export",
+            ))
+            continue
+        if b.restype is None:
+            findings.append(Finding(
+                bindings_path, b.line, "AB005",
+                f"binding for {name!r} never sets restype "
+                f"(ctypes defaults to c_int)",
+            ))
+        elif not _types_match(d.ret, b.restype):
+            findings.append(Finding(
+                bindings_path, b.line, "AB005",
+                f"restype of {name!r} is {b.restype!r} but the native "
+                f"declaration returns {d.ret!r} "
+                f"({os.path.basename(d.path)}:{d.line})",
+                hint="a narrowed return truncates 64-bit counts/handles",
+            ))
+        if b.argtypes is None:
+            findings.append(Finding(
+                bindings_path, b.line, "AB005",
+                f"binding for {name!r} never sets argtypes "
+                f"(ctypes would guess from call-site values)",
+            ))
+            continue
+        if len(b.argtypes) != len(d.params):
+            findings.append(Finding(
+                bindings_path, b.line, "AB003",
+                f"{name!r} binds {len(b.argtypes)} parameters but the "
+                f"native declaration takes {len(d.params)} "
+                f"({os.path.basename(d.path)}:{d.line})",
+                hint="an arity drift shifts every later argument register",
+            ))
+            continue
+        for pos, (ct, pt) in enumerate(zip(d.params, b.argtypes)):
+            if not _types_match(ct, pt):
+                findings.append(Finding(
+                    bindings_path, b.line, "AB004",
+                    f"{name!r} parameter {pos} bound as {pt!r} but "
+                    f"declared {ct!r} ({os.path.basename(d.path)}:{d.line})",
+                    hint="width mismatches corrupt memory silently; fix "
+                         "whichever side drifted",
+                ))
+
+    for name, b in sorted(bindings.items()):
+        if name not in decls:
+            findings.append(Finding(
+                bindings_path, b.line, "AB002",
+                f"binding names symbol {name!r} but no extern \"C\" block "
+                f"under {native_dir} declares it",
+                hint="a renamed native function leaves the old binding "
+                     "resolving to nothing (AttributeError at best)",
+            ))
+    return findings

@@ -1,0 +1,590 @@
+"""Jit-hazard linter: AST checks inside ``jax.jit``-compiled functions.
+
+The tier-1 lane runs on CPU where several classes of jit misuse pass
+silently (or merely recompile) but break or crawl on TPU. This linter
+walks every module under ``gelly_tpu/``, finds functions compiled with
+``jax.jit`` — bare decorator, ``partial(jax.jit, ...)`` decorator, or a
+``jax.jit(fn)`` call naming a local function — and flags, inside them
+and inside the local functions they call (one level deep):
+
+- ``GL001`` ``np.*`` call on a traced value — host numpy forces a
+  device sync under jit and fails on abstract tracers.
+- ``GL002`` Python ``if``/``while`` on a traced value — data-dependent
+  control flow raises ``TracerBoolConversionError`` at trace time.
+- ``GL003`` ``.item()`` / ``.tolist()`` / ``int()`` / ``float()`` /
+  ``bool()`` coercion of a traced value — same trace-time failure.
+- ``GL004`` dict iteration (``.values()``/``.keys()``/``.items()``)
+  feeding ``jnp.stack``/``jnp.concatenate`` — insertion-order traces
+  recompile (or silently permute lanes) when callers build the dict in
+  a different order.
+- ``GL005`` untyped float literal in a dtype-sensitive constructor
+  (``jnp.array``/``asarray``/``full``/``full_like``/``arange`` without
+  ``dtype=``) — weak-typed literals resolve differently under x64,
+  splitting the jit cache between CPU tests and TPU runs.
+
+Trace-ness is tracked conservatively: the function's non-static
+parameters are traced, and locals assigned from traced expressions
+become traced. Attribute reads that are static at trace time
+(``.shape``/``.ndim``/``.dtype``/``.size``), ``len()``, ``isinstance``,
+and ``is None`` tests are understood as concrete and never flagged.
+
+Suppress a finding by appending ``# graphlint: disable=GL00x`` (comma
+list or ``all``) to the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from . import Finding
+
+RULES: dict[str, tuple[str, str]] = {
+    "GL001": (
+        "numpy call on a traced value inside jit",
+        "use the jnp equivalent, or hoist the host-side numpy work out "
+        "of the jitted function",
+    ),
+    "GL002": (
+        "Python control flow on a traced value inside jit",
+        "data-dependent branches fail at trace time: use jnp.where / "
+        "jax.lax.cond / jax.lax.while_loop, or mark the argument in "
+        "static_argnames",
+    ),
+    "GL003": (
+        "host coercion of a traced value inside jit",
+        ".item()/int()/float() force a concrete value during tracing: "
+        "return the array and coerce outside the jitted function",
+    ),
+    "GL004": (
+        "dict iteration feeding a stacked array inside jit",
+        "iterate sorted(d.items()) (or another explicit order) so the "
+        "trace does not depend on dict insertion order",
+    ),
+    "GL005": (
+        "untyped float literal in a dtype-sensitive constructor",
+        "pass dtype= explicitly; weak-typed literals resolve differently "
+        "with and without x64, splitting the jit cache",
+    ),
+}
+
+# Attribute reads that are concrete (static) under tracing. `capacity`
+# is the repo convention for a shape read (EdgeChunk.capacity is
+# src.shape[0]).
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type", "capacity"}
+# Builtins whose results are concrete under tracing.
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "range"}
+# jnp constructors with a dtype parameter: name -> index of the dtype
+# positional slot (args at or past it mean dtype was passed).
+_DTYPE_SENSITIVE = {"array": 1, "asarray": 1, "full": 2, "full_like": 2,
+                    "arange": 3}
+_STACKERS = {"stack", "concatenate", "vstack", "hstack", "column_stack"}
+_COERCERS = {"int", "float", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist"}
+
+_SUPPRESS_RE = re.compile(r"#\s*graphlint:\s*disable=([A-Za-z0-9,\s]+)")
+
+
+def _attr_chain(node: ast.AST):
+    """('jax','numpy','stack') for jax.numpy.stack; None if not a plain
+    dotted name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class _Module:
+    path: str
+    dotted: str                      # gelly_tpu.core.stream
+    tree: ast.Module
+    lines: list[str]
+    numpy_aliases: set
+    jnp_aliases: set                 # names bound to jax.numpy
+    jax_aliases: set                 # names bound to jax itself
+    jit_names: set                   # names bound to jax.jit via from-import
+    module_aliases: dict             # local name -> module path on disk
+    from_functions: dict             # local name -> (module path, def name)
+    functions: dict                  # def name -> ast.FunctionDef, for call
+    #   resolution (module-level defs win over same-named nested ones)
+    all_functions: list              # EVERY def node — lint iterates this,
+    #   so a jitted function shadowed by a later same-named def still runs
+    jit_called: dict                 # def name -> statics (jax.jit(f) form)
+
+
+class JitLinter:
+    """Lints a set of Python files; loads cross-module callees lazily."""
+
+    def __init__(self, package_root: str):
+        # package_root is the directory CONTAINING the gelly_tpu package.
+        self.package_root = os.path.abspath(package_root)
+        self._modules: dict[str, _Module] = {}
+        self._visited: set = set()
+        self.findings: list[Finding] = []
+
+    # ---------------------------------------------------------- loading
+
+    def _dotted_name(self, path: str) -> str:
+        rel = os.path.relpath(os.path.abspath(path), self.package_root)
+        rel = rel[:-3] if rel.endswith(".py") else rel
+        parts = [p for p in rel.split(os.sep) if p != "."]
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def _module_path(self, dotted: str):
+        base = os.path.join(self.package_root, *dotted.split("."))
+        for cand in (base + ".py", os.path.join(base, "__init__.py")):
+            if os.path.exists(cand):
+                return cand
+        return None
+
+    def load(self, path: str):
+        path = os.path.abspath(path)
+        if path in self._modules:
+            return self._modules[path]
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+        m = _Module(
+            path=path, dotted=self._dotted_name(path), tree=tree,
+            lines=src.splitlines(), numpy_aliases=set(), jnp_aliases=set(),
+            jax_aliases=set(), jit_names=set(), module_aliases={},
+            from_functions={}, functions={}, all_functions=[], jit_called={},
+        )
+        self._collect_imports(m)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                m.functions[node.name] = node
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                m.all_functions.append(node)
+                m.functions.setdefault(node.name, node)
+        self._collect_jit_calls(m)
+        self._modules[path] = m
+        return m
+
+    def _collect_imports(self, m: _Module) -> None:
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "numpy":
+                        m.numpy_aliases.add(local)
+                    elif alias.name == "jax.numpy":
+                        m.jnp_aliases.add(alias.asname or "jax")
+                    elif alias.name == "jax":
+                        m.jax_aliases.add(local)
+                    elif alias.name.split(".")[0] == "gelly_tpu":
+                        p = self._module_path(alias.name)
+                        if p:
+                            m.module_aliases[alias.asname
+                                             or alias.name.split(".")[-1]] = p
+            elif isinstance(node, ast.ImportFrom):
+                self._collect_import_from(m, node)
+
+    def _collect_import_from(self, m: _Module, node: ast.ImportFrom) -> None:
+        if node.level == 0 and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "numpy":
+                    m.jnp_aliases.add(alias.asname or "numpy")
+                elif alias.name == "jit":
+                    m.jit_names.add(alias.asname or "jit")
+            return
+        if node.level == 0 and node.module == "jax.numpy":
+            return  # from jax.numpy import x — per-symbol, not linted
+        # Resolve the source module (absolute gelly_tpu.* or relative).
+        if node.level == 0:
+            if not (node.module or "").startswith("gelly_tpu"):
+                return
+            base = node.module
+        else:
+            pkg = m.dotted.split(".")
+            # level=1 strips the module name itself; each extra level one
+            # package more.
+            pkg = pkg[: len(pkg) - node.level]
+            base = ".".join(pkg + ([node.module] if node.module else []))
+        for alias in node.names:
+            local = alias.asname or alias.name
+            sub = self._module_path(f"{base}.{alias.name}")
+            if sub:
+                m.module_aliases[local] = sub
+                continue
+            src = self._module_path(base)
+            if src:
+                m.from_functions[local] = (src, alias.name)
+
+    def _is_jax_jit(self, m: _Module, node: ast.AST) -> bool:
+        chain = _attr_chain(node)
+        if chain is None:
+            return False
+        if len(chain) == 1:
+            return chain[0] in m.jit_names
+        return len(chain) == 2 and chain[0] in m.jax_aliases \
+            and chain[1] == "jit"
+
+    def _jit_statics(self, m: _Module, call: ast.Call):
+        """static param names/positions from a jax.jit(...) call node."""
+        names: set = set()
+        nums: list[int] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    names.update(e.value for e in v.elts
+                                 if isinstance(e, ast.Constant))
+            elif kw.arg == "static_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.append(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    nums.extend(e.value for e in v.elts
+                                if isinstance(e, ast.Constant))
+        return names, nums
+
+    def _jit_decoration(self, m: _Module, fn: ast.FunctionDef):
+        """(is_jitted, static names, static positions) from decorators."""
+        for dec in fn.decorator_list:
+            if self._is_jax_jit(m, dec):
+                return True, set(), []
+            if isinstance(dec, ast.Call):
+                if self._is_jax_jit(m, dec.func):
+                    names, nums = self._jit_statics(m, dec)
+                    return True, names, nums
+                if dec.args and self._is_jax_jit(m, dec.args[0]):
+                    # partial(jax.jit, ...) under any partial spelling
+                    names, nums = self._jit_statics(m, dec)
+                    return True, names, nums
+        return False, set(), []
+
+    def _collect_jit_calls(self, m: _Module) -> None:
+        """Record ``jax.jit(fn)`` calls that name a local function."""
+        for node in ast.walk(m.tree):
+            if (isinstance(node, ast.Call) and self._is_jax_jit(m, node.func)
+                    and node.args and isinstance(node.args[0], ast.Name)):
+                names, nums = self._jit_statics(m, node)
+                m.jit_called[node.args[0].id] = (names, nums)
+
+    # ---------------------------------------------------------- linting
+
+    def lint_paths(self, paths) -> list[Finding]:
+        files: list[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for dirpath, _dirnames, filenames in os.walk(p):
+                    if "__pycache__" in dirpath:
+                        continue
+                    files.extend(os.path.join(dirpath, f)
+                                 for f in sorted(filenames)
+                                 if f.endswith(".py"))
+            else:
+                files.append(p)
+        for path in sorted(set(files)):
+            self.lint_file(path)
+        return self.findings
+
+    def lint_file(self, path: str) -> None:
+        m = self.load(path)
+        for fn in m.all_functions:
+            jitted, statics, nums = self._jit_decoration(m, fn)
+            if not jitted and fn.name in m.jit_called:
+                jitted = True
+                statics, nums = m.jit_called[fn.name]
+            if jitted:
+                traced = self._traced_params(fn, statics, nums)
+                self._lint_function(m, fn, traced,
+                                    via=f"jitted {fn.name!r}", expand=True)
+
+    @staticmethod
+    def _traced_params(fn: ast.FunctionDef, statics, nums) -> set:
+        pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        static = set(statics) | {pos[i] for i in nums if i < len(pos)}
+        params = pos + [a.arg for a in fn.args.kwonlyargs]
+        return {p for p in params
+                if p not in static and p not in ("self", "cls")}
+
+    def _suppressed(self, m: _Module, line: int, rule: str) -> bool:
+        if 1 <= line <= len(m.lines):
+            sm = _SUPPRESS_RE.search(m.lines[line - 1])
+            if sm:
+                ids = {s.strip().upper() for s in sm.group(1).split(",")}
+                return rule.upper() in ids or "ALL" in ids
+        return False
+
+    def _emit(self, m: _Module, node: ast.AST, rule: str, detail: str,
+              via: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if self._suppressed(m, line, rule):
+            return
+        summary, hint = RULES[rule]
+        f = Finding(m.path, line, rule, f"{summary}: {detail} [{via}]",
+                    hint=hint)
+        if f not in self.findings:
+            self.findings.append(f)
+
+    def _lint_function(self, m: _Module, fn: ast.FunctionDef, traced: set,
+                       via: str, expand: bool) -> None:
+        key = (m.path, fn.lineno, frozenset(traced))
+        if key in self._visited:
+            return
+        self._visited.add(key)
+        _FunctionLint(self, m, traced, via, expand).run(fn)
+
+    # ------------------------------------------------- callee expansion
+
+    def expand_call(self, m: _Module, call: ast.Call, traced_args: list,
+                    via: str) -> None:
+        """Lint a called local/sibling-module function one level deep.
+
+        ``traced_args`` is ``[(argname_or_None, is_traced), ...]`` in call
+        order (None argname = positional).
+        """
+        target = self._resolve_callee(m, call.func)
+        if target is None:
+            return
+        callee_module, callee = target
+        jitted, _s, _n = self._jit_decoration(callee_module, callee)
+        if jitted or callee.name in callee_module.jit_called:
+            return  # linted in its own right
+        pos = [a.arg for a in callee.args.posonlyargs + callee.args.args]
+        if pos and pos[0] in ("self", "cls"):
+            return
+        traced: set = set()
+        i = 0
+        for argname, is_traced in traced_args:
+            if argname is None:
+                if i < len(pos) and is_traced:
+                    traced.add(pos[i])
+                i += 1
+            elif is_traced:
+                traced.add(argname)
+        if not traced:
+            return
+        self._lint_function(
+            callee_module, callee, traced,
+            via=f"{via} -> {callee.name!r}", expand=False,
+        )
+
+    def _resolve_callee(self, m: _Module, func: ast.AST):
+        if isinstance(func, ast.Name):
+            if func.id in m.from_functions:
+                path, name = m.from_functions[func.id]
+                mod = self.load(path)
+                fn = mod.functions.get(name)
+                return (mod, fn) if fn is not None else None
+            fn = m.functions.get(func.id)
+            return (m, fn) if fn is not None else None
+        chain = _attr_chain(func)
+        if chain and len(chain) == 2 and chain[0] in m.module_aliases:
+            mod = self.load(m.module_aliases[chain[0]])
+            fn = mod.functions.get(chain[1])
+            return (mod, fn) if fn is not None else None
+        return None
+
+
+class _FunctionLint:
+    """One pass over a single function body, statement order, tracking
+    which locals hold traced values."""
+
+    def __init__(self, linter: JitLinter, m: _Module, traced: set,
+                 via: str, expand: bool):
+        self.linter = linter
+        self.m = m
+        self.tr = set(traced)
+        self.via = via
+        self.expand = expand
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        for stmt in fn.body:
+            self._stmt(stmt)
+
+    # ------------------------------------------------------- statements
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs are linted on their own if jitted
+        if isinstance(node, (ast.If, ast.While)):
+            refs = self._concrete_refs(node.test)
+            if refs:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self.linter._emit(
+                    self.m, node, "GL002",
+                    f"`{kind}` tests traced value(s) "
+                    f"{', '.join(sorted(refs))}", self.via)
+            self._expr(node.test)
+            for s in node.body:
+                self._stmt(s)
+            for s in getattr(node, "orelse", []):
+                self._stmt(s)
+            return
+        if isinstance(node, ast.For):
+            self._expr(node.iter)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._expr(item.context_expr)
+            for s in node.body:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for s in node.body + node.orelse + node.finalbody:
+                self._stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            if self._concrete_refs(node.value):
+                for tgt in node.targets:
+                    self._mark_traced(tgt)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value)
+            if self._concrete_refs(node.value):
+                self._mark_traced(node.target)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._expr(node.value)
+                if self._concrete_refs(node.value):
+                    self._mark_traced(node.target)
+            return
+        if isinstance(node, (ast.Return, ast.Expr)) and node.value is not None:
+            self._expr(node.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _mark_traced(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.tr.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._mark_traced(elt)
+
+    # ------------------------------------------------------ expressions
+
+    def _expr(self, node: ast.expr) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub)
+
+    def _call(self, call: ast.Call) -> None:
+        m, via = self.m, self.via
+        chain = _attr_chain(call.func)
+        arg_exprs = list(call.args) + [kw.value for kw in call.keywords]
+
+        if chain and chain[0] in m.numpy_aliases:
+            traced = sorted(set().union(
+                *(self._concrete_refs(a) for a in arg_exprs), set()))
+            if traced:
+                self.linter._emit(
+                    m, call, "GL001",
+                    f"np.{'.'.join(chain[1:])} applied to traced "
+                    f"{', '.join(traced)}", via)
+
+        is_jnp = chain is not None and (
+            chain[0] in m.jnp_aliases
+            or (len(chain) > 2 and chain[0] in m.jax_aliases
+                and chain[1] == "numpy"))
+        if is_jnp:
+            name = chain[-1]
+            if name in _STACKERS:
+                for sub in ast.walk(call):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr in ("values", "keys", "items")
+                            and not sub.args):
+                        self.linter._emit(
+                            m, call, "GL004",
+                            f"jnp.{name} consumes dict .{sub.func.attr}() "
+                            "iteration", via)
+                        break
+            if name in _DTYPE_SENSITIVE:
+                dtype_pos = _DTYPE_SENSITIVE[name]
+                has_dtype = len(call.args) > dtype_pos or any(
+                    kw.arg == "dtype" for kw in call.keywords)
+                if not has_dtype:
+                    lit = next(
+                        (a for a in call.args
+                         if isinstance(a, ast.Constant)
+                         and isinstance(a.value, float)), None)
+                    if lit is not None:
+                        self.linter._emit(
+                            m, call, "GL005",
+                            f"jnp.{name}(... {lit.value} ...) without "
+                            "dtype=", via)
+
+        if (isinstance(call.func, ast.Name) and call.func.id in _COERCERS
+                and call.args):
+            refs = self._concrete_refs(call.args[0])
+            if refs:
+                self.linter._emit(
+                    m, call, "GL003",
+                    f"{call.func.id}() applied to traced "
+                    f"{', '.join(sorted(refs))}", via)
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _SYNC_METHODS):
+            refs = self._concrete_refs(call.func.value)
+            if refs:
+                self.linter._emit(
+                    m, call, "GL003",
+                    f".{call.func.attr}() applied to traced "
+                    f"{', '.join(sorted(refs))}", via)
+
+        if self.expand and chain is not None and len(chain) <= 2:
+            traced_args = [(None, bool(self._concrete_refs(a)))
+                           for a in call.args]
+            traced_args += [(kw.arg, bool(self._concrete_refs(kw.value)))
+                            for kw in call.keywords if kw.arg]
+            self.linter.expand_call(m, call, traced_args, via)
+
+    # ------------------------------------------------------- trace-ness
+
+    def _concrete_refs(self, node: ast.expr) -> set:
+        """Traced names an expression uses CONCRETELY (i.e. in a way that
+        needs a concrete value or produces a traced one), ignoring
+        shape/dtype reads, len(), isinstance(), and `is None` tests."""
+        if isinstance(node, ast.Name):
+            return {node.id} if node.id in self.tr else set()
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return set()
+            return self._concrete_refs(node.value)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return set()
+        if isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _STATIC_CALLS):
+                return set()
+        out: set = set()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out |= self._concrete_refs(child)
+            elif isinstance(child, ast.comprehension):
+                out |= self._concrete_refs(child.iter)
+        return out
+
+
+def lint_paths(package_root: str, paths) -> list[Finding]:
+    """Convenience wrapper: lint ``paths`` with a fresh :class:`JitLinter`
+    rooted at ``package_root`` (the directory containing ``gelly_tpu``)."""
+    return JitLinter(package_root).lint_paths(paths)

@@ -123,6 +123,10 @@ class CompactIdSession:
         ids = np.ascontiguousarray(ids, np.int32)
         with self._lock:
             if self._native is not None:
+                # NativeCompactSession.assign rejects negative ids (the
+                # native probe table treats negative entries as holes —
+                # they would drop out at the next rehash and be
+                # re-assigned a second cid).
                 cids, new_ids, base = self._native.assign(ids)
                 if base < 0:
                     raise CompactSpaceOverflow(
@@ -132,6 +136,12 @@ class CompactIdSession:
                         "vertices per window, not edges)"
                     )
                 return cids, new_ids, base
+            if ids.size and int(ids.min()) < 0:
+                # Same contract as the native backend.
+                raise ValueError(
+                    f"compact-id assign: negative vertex ids (min="
+                    f"{int(ids.min())})"
+                )
             pos = np.searchsorted(self._known, ids)
             found = pos < self._known.shape[0]
             found[found] = self._known[pos[found]] == ids[found]
@@ -204,6 +214,15 @@ class CompactIdSession:
         summary is the durable record of every assignment, so resume needs
         no separate codec snapshot."""
         vertex_of = np.asarray(vertex_of)
+        if vertex_of.shape[0] > self.capacity:
+            # Same contract on both backends (the native session returns
+            # -1 here): truncating would drop assignments and re-issue
+            # their cids.
+            raise ValueError(
+                f"compact-id rebuild: checkpoint holds "
+                f"{vertex_of.shape[0]} cids but compact_capacity is "
+                f"{self.capacity}"
+            )
         if self._native is not None:
             with self._lock:
                 self._native.rebuild(vertex_of)

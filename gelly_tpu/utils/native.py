@@ -26,6 +26,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import weakref
 
 import numpy as np
 
@@ -39,24 +40,53 @@ _libs: dict = {}
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 
+# Sanitizer lane (gelly_tpu/analysis/sanitize.py): GELLY_NATIVE_SANITIZE
+# selects an instrumented build of every native component. Sanitized
+# shared objects get their own cache names (lib<stem>.<mode>.so) so the
+# production .so never carries sanitizer runtime dependencies. Loading an
+# instrumented .so into a plain CPython requires the sanitizer runtime in
+# LD_PRELOAD — analysis/sanitize.py sets that up for its subprocess; a
+# bare GELLY_NATIVE_SANITIZE without the preload fails the dlopen, which
+# available() reports as the component being unavailable.
+_SANITIZE_FLAGS = {
+    "asan": ("-g", "-fsanitize=address", "-fno-omit-frame-pointer"),
+    "ubsan": ("-g", "-fsanitize=undefined", "-fno-sanitize-recover=undefined"),
+}
+
+
+def _sanitize_mode() -> str:
+    """Active GELLY_NATIVE_SANITIZE mode ('' = off). Unknown values raise:
+    silently building an uninstrumented .so would defeat the lane."""
+    mode = os.environ.get("GELLY_NATIVE_SANITIZE", "").strip().lower()
+    if mode and mode not in _SANITIZE_FLAGS:
+        raise ValueError(
+            f"GELLY_NATIVE_SANITIZE={mode!r}: expected one of "
+            f"{sorted(_SANITIZE_FLAGS)} or unset"
+        )
+    return mode
+
 
 def _load_lib(stem: str) -> ctypes.CDLL:
     """Compile native/<stem>.cc to lib<stem>.so (mtime-cached) and dlopen it."""
     with _lock:
-        if stem in _libs:
-            return _libs[stem]
+        mode = _sanitize_mode()
+        key = (stem, mode)
+        if key in _libs:
+            return _libs[key]
         src = os.path.join(_NATIVE_DIR, f"{stem}.cc")
-        so = os.path.join(_NATIVE_DIR, f"lib{stem}.so")
+        suffix = f".{mode}" if mode else ""
+        so = os.path.join(_NATIVE_DIR, f"lib{stem}{suffix}.so")
         if not os.path.exists(so) or (
             os.path.exists(src)
             and os.path.getmtime(src) > os.path.getmtime(so)
         ):
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", so, src],
-                check=True, capture_output=True,
-            )
+            cmd = ["g++", "-O3", "-shared", "-fPIC"]
+            if mode:
+                cmd.extend(_SANITIZE_FLAGS[mode])
+            cmd.extend(["-o", so, src])
+            subprocess.run(cmd, check=True, capture_output=True)
         lib = ctypes.CDLL(so)
-        _libs[stem] = lib
+        _libs[key] = lib
         return lib
 
 
@@ -534,12 +564,13 @@ class UnitForestBuilder:
         self._h = self._lib.cc_unit_begin()
         if not self._h:
             raise MemoryError("cc_unit_begin failed")
-
-    def __del__(self):
-        h = getattr(self, "_h", None)
-        if h:
-            self._lib.cc_unit_destroy(h)
-            self._h = None
+        # weakref.finalize instead of __del__: it runs at most once, pins
+        # the ctypes function + handle it needs, and fires via atexit
+        # before module globals are torn down — so interpreter-shutdown
+        # teardown cannot hit a half-collected module and raise.
+        self._finalize = weakref.finalize(
+            self, self._lib.cc_unit_destroy, self._h
+        )
 
     def add(self, src: np.ndarray, dst: np.ndarray,
             valid: np.ndarray | None) -> None:
@@ -575,7 +606,7 @@ class UnitForestBuilder:
             counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         )
         _sparse_rc_check(rc, "cc_unit_finish")
-        self._lib.cc_unit_destroy(self._h)
+        self._finalize()  # destroys the handle now; idempotent thereafter
         self._h = None
         return out_v[: counts[0]], out_len[: counts[1]]
 
@@ -590,33 +621,58 @@ class NativeCompactSession:
 
     def __init__(self, capacity: int):
         self._lib = _load_combiner()
-        self._h = self._lib.compact_session_create(int(capacity))
+        self._capacity = int(capacity)
+        self._h = self._lib.compact_session_create(self._capacity)
         if not self._h:
             raise MemoryError("compact_session_create failed")
+        # Same finalize-over-__del__ rationale as UnitForestBuilder.
+        self._finalize = weakref.finalize(
+            self, self._lib.compact_session_destroy, self._h
+        )
 
-    def __del__(self):
-        h = getattr(self, "_h", None)
-        if h:
-            self._lib.compact_session_destroy(h)
-            self._h = None
+    def _handle(self):
+        if not self._h:
+            raise RuntimeError(
+                "compact session discarded after a native allocation "
+                "failure; create a new session"
+            )
+        return self._h
+
+    def _poison(self):
+        """Destroy the handle after a native -4: the C side may have
+        failed its rollback rehash too, leaving a probe table that
+        aliases dropped cids — the session must not be reused."""
+        self._finalize()
+        self._h = None
 
     def reset(self) -> None:
-        self._lib.compact_session_reset(self._h)
+        self._lib.compact_session_reset(self._handle())
 
     @property
     def assigned(self) -> int:
-        return int(self._lib.compact_session_assigned(self._h))
+        return int(self._lib.compact_session_assigned(self._handle()))
 
     def assign(self, ids: np.ndarray):
         """(cids, new_ids, base) — fresh ids get cids in first-seen ARRAY
-        order. Returns base=-1 on capacity overflow (session unchanged)."""
+        order. Returns base=-1 on capacity overflow (session unchanged).
+        Negative ids raise ValueError (the probe table treats negative
+        entries as holes, so they could never round-trip a lookup)."""
         ids = np.ascontiguousarray(ids, np.int32)
+        if ids.size and int(ids.min()) < 0:
+            raise ValueError(
+                "compact_session_assign: negative vertex ids "
+                f"(min={int(ids.min())})"
+            )
         out = np.empty(ids.shape[0], np.int32)
         base = self._lib.compact_session_assign(
-            self._h, _as_i32p(ids), ids.shape[0], _as_i32p(out)
+            self._handle(), _as_i32p(ids), ids.shape[0], _as_i32p(out)
         )
         if base == -4:
+            self._poison()
             raise MemoryError("compact_session_assign: allocation failed")
+        if base == -2:
+            # Native-side backstop of the validation above.
+            raise ValueError("compact_session_assign: negative vertex id")
         if base < 0:
             return None, None, -1
         top = self.assigned
@@ -632,16 +688,28 @@ class NativeCompactSession:
         ids = np.ascontiguousarray(ids, np.int32)
         out = np.empty(ids.shape[0], np.int32)
         bad = self._lib.compact_session_lookup(
-            self._h, _as_i32p(ids), ids.shape[0], _as_i32p(out)
+            self._handle(), _as_i32p(ids), ids.shape[0], _as_i32p(out)
         )
         return out, int(bad)
 
     def rebuild(self, vertex_of: np.ndarray) -> None:
         vertex_of = np.ascontiguousarray(vertex_of, np.int32)
         rc = self._lib.compact_session_rebuild(
-            self._h, _as_i32p(vertex_of), vertex_of.shape[0]
+            self._handle(), _as_i32p(vertex_of), vertex_of.shape[0]
         )
+        if rc == -1:
+            # Truncating would drop checkpointed assignments and later
+            # re-issue those cids — fail loudly instead.
+            raise ValueError(
+                f"compact_session_rebuild: checkpoint holds "
+                f"{vertex_of.shape[0]} cids but session capacity is "
+                f"{self._capacity}; resume with compact_capacity >= "
+                f"{vertex_of.shape[0]}"
+            )
         if rc != 0:
+            # A failed rehash leaves the probe table inconsistent with
+            # the restored vert_of — discard the session.
+            self._poison()
             raise MemoryError("compact_session_rebuild: allocation failed")
 
 

@@ -557,6 +557,17 @@ bool cs_rehash(CompactSession* s, int64_t tsize) {
   return true;
 }
 
+// Roll an assign call back to its pre-call state: drop the fresh
+// entries and rebuild the probe table from the surviving ones. Error
+// paths only. Returns false when even the rollback rehash cannot
+// allocate (the probe table then still holds the dropped entries and
+// the session must be discarded — every caller treats that as fatal).
+bool cs_rollback(CompactSession* s, int32_t base) {
+  if (s->count == base) return true;
+  s->count = base;
+  return cs_rehash(s, s->tsize);
+}
+
 }  // namespace
 
 extern "C" {
@@ -596,14 +607,22 @@ int32_t compact_session_assigned(void* h) {
 
 // Assign cids to ids (fresh ids get count, count+1, ... in first-seen
 // ARRAY order). Returns the pre-call count (the new block's base), or
-// -1 on capacity overflow (the session is rolled back to the pre-call
-// state), or -4 on allocation failure.
+// -1 on capacity overflow, -2 on a negative id, or -4 on allocation
+// failure. Every error path rolls the session back to the pre-call
+// state (atomic-assign contract).
 int64_t compact_session_assign(void* h, const int32_t* ids, int64_t n,
                                int32_t* out_cids) {
   CompactSession* s = static_cast<CompactSession*>(h);
   const int32_t base = s->count;
   for (int64_t j = 0; j < n; ++j) {
     const int32_t v = ids[j];
+    if (v < 0) {
+      // cs_rehash treats negative vert_of entries as holes: a negative
+      // id would silently fall out of the probe table at the next table
+      // growth and later be re-assigned a second cid. Reject it.
+      if (!cs_rollback(s, base)) return -4;
+      return -2;
+    }
     int64_t i = cs_hash(v, s->mask);
     int32_t e;
     while ((e = s->table[i]) >= 0 && s->vert_of[e] != v) {
@@ -614,17 +633,19 @@ int64_t compact_session_assign(void* h, const int32_t* ids, int64_t n,
       continue;
     }
     if (s->count >= s->capacity) {
-      // Roll back this call's inserts (atomic-assign contract): rebuild
-      // the probe table from the first `base` entries. Error path only.
-      s->count = base;
-      if (!cs_rehash(s, s->tsize)) return -4;
+      if (!cs_rollback(s, base)) return -4;
       return -1;
     }
     s->table[i] = s->count;
     s->vert_of[s->count] = v;
     out_cids[j] = s->count++;
     if (2 * static_cast<int64_t>(s->count) >= s->tsize) {
-      if (!cs_rehash(s, s->tsize * 2)) return -4;
+      if (!cs_rehash(s, s->tsize * 2)) {
+        // Mid-call growth failure: roll back like the paths above so
+        // the caller never observes a partial assign block.
+        cs_rollback(s, base);
+        return -4;
+      }
     }
   }
   return base;
@@ -835,11 +856,14 @@ int cc_unit_forest_segments(const int32_t* src, const int32_t* dst,
 
 // Restore from a checkpointed vertex_of array (vertex_of[cid] = global
 // id, -1 for unassigned): count resumes past the highest recorded cid;
-// holes stay dead. Returns 0, or -4 on allocation failure.
+// holes stay dead. Returns 0, -1 when the checkpoint exceeds the
+// session capacity (truncating would drop assignments and later
+// re-issue those cids), or -4 on allocation failure.
 int compact_session_rebuild(void* h, const int32_t* vertex_of, int32_t m) {
   CompactSession* s = static_cast<CompactSession*>(h);
+  if (m > s->capacity) return -1;
   int32_t hi = -1;
-  for (int32_t c = 0; c < m && c < s->capacity; ++c) {
+  for (int32_t c = 0; c < m; ++c) {
     s->vert_of[c] = vertex_of[c];
     if (vertex_of[c] >= 0) hi = c;
   }

@@ -1,0 +1,506 @@
+"""gelly_tpu.analysis: ABI cross-checker, jit-hazard linter, sanitizer
+lane — plus regression tests for the native-session hardening that rode
+along (negative-id rejection, rebuild overflow, finalize teardown)."""
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from gelly_tpu.analysis import abi, jitlint, sanitize
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+NATIVE_DIR = os.path.join(REPO, "native")
+BINDINGS = os.path.join(REPO, "gelly_tpu", "utils", "native.py")
+
+
+def _toolchain():
+    return shutil.which("g++") is not None
+
+
+# --------------------------------------------------------------------- #
+# ABI cross-checker
+
+def test_abi_clean_on_repo_tip():
+    findings = abi.cross_check(NATIVE_DIR, BINDINGS)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_abi_parses_every_native_symbol():
+    # The checker must actually see the full surface: every symbol the
+    # bindings module declares exists in some extern "C" block and
+    # vice versa (the clean diff above is vacuous if either parse came
+    # back empty).
+    import glob
+
+    decls = {}
+    for cc in glob.glob(os.path.join(NATIVE_DIR, "*.cc")):
+        ds, fs = abi.parse_extern_c(cc)
+        assert fs == []
+        decls.update((d.name, d) for d in ds)
+    bindings, fs = abi.parse_ctypes_bindings(BINDINGS)
+    assert fs == []
+    assert set(decls) == set(bindings)
+    assert len(bindings) >= 25  # the full native surface, not a subset
+    # every binding is complete — restype AND argtypes
+    for b in bindings.values():
+        assert b.restype is not None, b.name
+        assert b.argtypes is not None, b.name
+
+
+FIXTURE_CC = textwrap.dedent("""\
+    // fixture: deliberate ABI drift against fixture_bindings.py
+    #include <cstdint>
+
+    extern "C" {
+
+    // ok: bound correctly
+    int good_fn(const int32_t* a, int64_t n);
+
+    // AB004: bound as _i32p but declared int64_t*
+    int width_fn(const int64_t* a, int64_t n) { return n > 0 ? 1 : 0; }
+
+    // AB003: bound with 2 params, declared with 3
+    int arity_fn(const int32_t* a, int64_t n, int32_t flags);
+
+    // AB005: returns int64_t, bound as c_int
+    int64_t ret_fn(void);
+
+    // AB001: never bound
+    void unbound_fn(int32_t x);
+
+    }  // extern "C"
+""")
+
+FIXTURE_PY = textwrap.dedent("""\
+    import ctypes
+
+    _i32p = ctypes.POINTER(ctypes.c_int32)
+
+
+    def bind(lib):
+        lib.good_fn.restype = ctypes.c_int
+        lib.good_fn.argtypes = [_i32p, ctypes.c_int64]
+        lib.width_fn.restype = ctypes.c_int
+        lib.width_fn.argtypes = [_i32p, ctypes.c_int64]
+        lib.arity_fn.restype = ctypes.c_int
+        lib.arity_fn.argtypes = [_i32p, ctypes.c_int64]
+        lib.ret_fn.restype = ctypes.c_int
+        lib.ret_fn.argtypes = []
+        lib.ghost_fn.restype = ctypes.c_int     # AB002: no such symbol
+        lib.ghost_fn.argtypes = []
+""")
+
+
+@pytest.fixture
+def abi_fixture(tmp_path):
+    native = tmp_path / "native"
+    native.mkdir()
+    (native / "fixture.cc").write_text(FIXTURE_CC)
+    py = tmp_path / "fixture_bindings.py"
+    py.write_text(FIXTURE_PY)
+    return str(native), str(py)
+
+
+def test_abi_detects_seeded_mismatches(abi_fixture):
+    native, py = abi_fixture
+    findings = abi.cross_check(native, py)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert set(by_rule) == {"AB001", "AB002", "AB003", "AB004", "AB005"}
+    [w] = by_rule["AB004"]
+    assert "width_fn" in w.message and "'i32*'" in w.message \
+        and "'i64*'" in w.message
+    [a] = by_rule["AB003"]
+    assert "arity_fn" in a.message and "2" in a.message and "3" in a.message
+    [r] = by_rule["AB005"]
+    assert "ret_fn" in r.message
+    [u] = by_rule["AB001"]
+    assert "unbound_fn" in u.message
+    [g] = by_rule["AB002"]
+    assert "ghost_fn" in g.message
+    # good_fn must NOT be reported
+    assert not any("good_fn" in f.message for f in findings)
+
+
+def test_abi_c_parser_handles_comments_strings_and_bodies(tmp_path):
+    cc = tmp_path / "c.cc"
+    cc.write_text(textwrap.dedent("""\
+        extern "C" {
+        // commented_out(int x);
+        /* also_commented(int x); */
+        int real_fn(const char* s, double d) {
+          const char* brace = "{ not a block }";  // string literal brace
+          if (d > 0) { return s[0]; }
+          return 0;
+        }
+        unsigned char byte_fn(unsigned char b);
+        }
+    """))
+    decls, findings = abi.parse_extern_c(str(cc))
+    assert findings == []
+    names = {d.name: d for d in decls}
+    assert set(names) == {"real_fn", "byte_fn"}
+    assert names["real_fn"].params == ["char*", "f64"]
+    assert names["real_fn"].ret == "i32"
+    assert names["byte_fn"].ret == "u8"
+    assert names["byte_fn"].params == ["u8"]
+
+
+def test_abi_cli_exit_codes(abi_fixture, tmp_path):
+    native, py = abi_fixture
+    clean = subprocess.run(
+        [sys.executable, "-m", "gelly_tpu.analysis", "--skip-jitlint"],
+        capture_output=True, text=True, cwd=REPO)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [sys.executable, "-m", "gelly_tpu.analysis", "--skip-jitlint",
+         "--native-dir", native, "--bindings", py],
+        capture_output=True, text=True, cwd=REPO)
+    assert dirty.returncode == 1
+    assert "AB004" in dirty.stdout
+
+
+# --------------------------------------------------------------------- #
+# jit-hazard linter
+
+JIT_FIXTURE = textwrap.dedent("""\
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+
+    @jax.jit
+    def np_on_traced(x):
+        return np.cumsum(x)                      # GL001
+
+
+    @jax.jit
+    def np_static_ok(x):
+        return x.reshape((int(np.prod(x.shape)),))  # shapes are static
+
+
+    @jax.jit
+    def branch_on_traced(x):
+        if x.sum() > 0:                          # GL002
+            return x
+        return -x
+
+
+    @jax.jit
+    def while_on_traced(x):
+        while x > 0:                             # GL002
+            x = x - 1
+        return x
+
+
+    @partial(jax.jit, static_argnames=("n",))
+    def branch_on_static(x, n):
+        if n > 2:                                # static arg: clean
+            return x * n
+        return x
+
+
+    @jax.jit
+    def structural_ok(x, valid=None):
+        if valid is None:                        # structural: clean
+            return x
+        if x.ndim == 2:                          # shape read: clean
+            return x[0]
+        return jnp.where(valid, x, 0)
+
+
+    @jax.jit
+    def coerce_traced(x):
+        return float(x) + x.item()               # GL003 (twice)
+
+
+    @jax.jit
+    def stack_dict(d):
+        return jnp.stack(list(d.values()))       # GL004
+
+
+    @jax.jit
+    def untyped_literal(x):
+        return x + jnp.full((4,), 0.25)          # GL005
+
+
+    @jax.jit
+    def typed_literal_ok(x):
+        return x + jnp.full((4,), 0.25, jnp.float32)
+
+
+    @jax.jit
+    def suppressed(x):
+        return np.cumsum(x)  # graphlint: disable=GL001
+
+
+    def helper(v, flag):
+        if flag:                                 # untraced at call: clean
+            v = v * 2
+        return np.asarray(v)                     # GL001 via expansion
+
+
+    @jax.jit
+    def calls_helper(x):
+        return helper(x, True)
+
+
+    def jit_by_call(x):
+        if x > 0:                                # GL002 (jax.jit(f) form)
+            return x
+        return -x
+
+
+    run = jax.jit(jit_by_call)
+""")
+
+
+@pytest.fixture
+def lint_fixture(tmp_path):
+    p = tmp_path / "jit_fixture.py"
+    p.write_text(JIT_FIXTURE)
+    return str(tmp_path), str(p)
+
+
+def test_jitlint_clean_on_repo_tip():
+    findings = jitlint.lint_paths(REPO, [os.path.join(REPO, "gelly_tpu")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_jitlint_detects_each_seeded_rule(lint_fixture):
+    root, path = lint_fixture
+    findings = jitlint.lint_paths(root, [path])
+    lines = {}
+    fixture_lines = JIT_FIXTURE.splitlines()
+    for f in findings:
+        lines.setdefault(f.rule, set()).add(fixture_lines[f.line - 1].strip())
+    assert set(lines) == {"GL001", "GL002", "GL003", "GL004", "GL005"}
+    assert any("np.cumsum" in ln for ln in lines["GL001"])
+    assert any("helper" not in ln and "np.asarray(v)" in ln
+               for ln in lines["GL001"])  # one-level call expansion
+    gl2 = " ".join(lines["GL002"])
+    assert "x.sum()" in gl2 and "while x > 0" in gl2
+    assert any("jax.jit(f) form" in ln or "x > 0" in ln
+               for ln in lines["GL002"])  # jax.jit(fn) call form
+    assert any("float(x)" in ln for ln in lines["GL003"])
+    assert any("d.values" in ln for ln in lines["GL004"])
+    assert any("0.25" in ln for ln in lines["GL005"])
+    # exemptions: statics, structural tests, shape reads, dtype'd literal
+    clean_fns = ("np_static_ok", "branch_on_static", "structural_ok",
+                 "typed_literal_ok", "suppressed")
+    for f in findings:
+        for fn in clean_fns:
+            assert fn not in f.message, f.render()
+
+
+def test_jitlint_suppression_is_line_scoped(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent("""\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = np.log(x)  # graphlint: disable=GL001
+            b = np.exp(x)
+            return a + b
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    assert len(findings) == 1
+    assert findings[0].rule == "GL001"
+    assert "np.exp" in findings[0].message
+
+
+def test_jitlint_cli_nonzero_on_fixture(lint_fixture):
+    root, path = lint_fixture
+    proc = subprocess.run(
+        [sys.executable, "-m", "gelly_tpu.analysis", "--skip-abi",
+         "--root", root, "--lint-path", path],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005"):
+        assert rule in proc.stdout, rule
+
+
+# --------------------------------------------------------------------- #
+# sanitizer lane
+
+def test_smoke_driver_runs_unsanitized():
+    # The workload itself must hold before the sanitizers wrap it.
+    pytest.importorskip("gelly_tpu.utils.native")
+    if not _toolchain():
+        pytest.skip("no native toolchain")
+    assert sanitize.smoke() == []
+
+
+@pytest.mark.sanitize
+@pytest.mark.parametrize("mode", ["asan", "ubsan"])
+def test_native_folds_clean_under_sanitizer(mode):
+    if not _toolchain():
+        pytest.skip("no native toolchain")
+    if not sanitize.sanitizer_available(mode):
+        pytest.skip(f"{mode} runtime unavailable")
+    proc = sanitize.run_smoke(mode)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert f"mode={mode}" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# native-session hardening regressions (satellites of this PR)
+
+def _native_session():
+    from gelly_tpu.utils import native
+
+    if not native.compact_session_available():
+        pytest.skip("native compact session unavailable")
+    return native
+
+
+def test_session_rejects_negative_ids():
+    native = _native_session()
+    s = native.NativeCompactSession(8)
+    s.assign(np.array([3, 4], np.int32))
+    with pytest.raises(ValueError, match="negative"):
+        s.assign(np.array([5, -1], np.int32))
+    # the failed call must not have assigned anything (atomic contract)
+    assert s.assigned == 2
+    out, bad = s.lookup(np.array([5], np.int32))
+    assert bad == 1 and out.tolist() == [-1]
+
+
+def test_compact_session_wrapper_rejects_negative_ids():
+    from gelly_tpu.ops.compact_space import CompactIdSession
+
+    s = CompactIdSession(8)
+    with pytest.raises(ValueError, match="negative"):
+        s.assign(np.array([1, -7], np.int32))
+    assert s.assigned == 0
+
+
+def test_session_rebuild_overflow_raises():
+    native = _native_session()
+    s = native.NativeCompactSession(4)
+    with pytest.raises(ValueError, match="capacity"):
+        s.rebuild(np.full(5, -1, np.int32))
+    # at-capacity checkpoint still restores
+    vo = np.array([9, 8, -1, 7], np.int32)
+    s.rebuild(vo)
+    assert s.assigned == 4
+    assert s.lookup(np.array([7], np.int32))[0].tolist() == [3]
+
+
+def test_compact_session_wrapper_rebuild_overflow_raises():
+    from gelly_tpu.ops.compact_space import CompactIdSession
+
+    s = CompactIdSession(4)
+    with pytest.raises(ValueError, match="compact_capacity|capacity"):
+        s.rebuild_from_vertex_of(np.full(6, -1, np.int32))
+
+
+def test_session_overflow_still_rolls_back():
+    native = _native_session()
+    s = native.NativeCompactSession(3)
+    s.assign(np.array([1, 2], np.int32))
+    cids, new_ids, base = s.assign(np.array([5, 6], np.int32))
+    assert (cids, new_ids, base) == (None, None, -1)
+    assert s.assigned == 2
+    # the rolled-back ids are re-assignable one at a time
+    _, _, base = s.assign(np.array([5], np.int32))
+    assert base == 2
+
+
+def test_session_poison_blocks_reuse():
+    # After a native allocation failure (-4) the C-side rollback itself
+    # may have failed, leaving a probe table that aliases dropped cids —
+    # the wrapper discards the handle and every later call must raise
+    # instead of reading the corrupt table.
+    native = _native_session()
+    s = native.NativeCompactSession(8)
+    s.assign(np.array([1], np.int32))
+    s._poison()
+    with pytest.raises(RuntimeError, match="discarded"):
+        s.assign(np.array([2], np.int32))
+    with pytest.raises(RuntimeError, match="discarded"):
+        s.lookup(np.array([1], np.int32))
+    assert not s._finalize.alive  # handle already destroyed, no leak
+
+
+def test_jitlint_lints_shadowed_same_name_functions(tmp_path):
+    # Two defs sharing a name (e.g. methods of different classes) must
+    # not shadow each other out of the lint pass.
+    p = tmp_path / "shadow.py"
+    p.write_text(textwrap.dedent("""\
+        import jax
+        import numpy as np
+
+        class A:
+            @staticmethod
+            @jax.jit
+            def step(x):
+                return np.cumsum(x)
+
+        class B:
+            @staticmethod
+            def step(x):
+                return x
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    assert [f.rule for f in findings] == ["GL001"]
+
+
+def test_abi_findings_anchor_to_declaration_lines():
+    decls, _ = abi.parse_extern_c(
+        os.path.join(NATIVE_DIR, "chunk_combiner.cc"))
+    with open(os.path.join(NATIVE_DIR, "chunk_combiner.cc")) as f:
+        lines = f.read().splitlines()
+    for d in decls:
+        assert d.name in lines[d.line - 1], (d.name, d.line)
+
+
+def test_finalize_teardown_is_idempotent_and_silent():
+    native = _native_session()
+    s = native.NativeCompactSession(4)
+    fin = s._finalize
+    del s
+    assert not fin.alive  # GC ran the finalizer exactly once
+
+    if not native.unit_segments_available():
+        return
+    b = native.UnitForestBuilder(8)
+    b.add(np.array([0], np.int32), np.array([1], np.int32), None)
+    b.finish()
+    assert not b._finalize.alive
+    with pytest.raises(RuntimeError, match="finished"):
+        b.finish()
+    del b  # second teardown is a no-op, not a double free
+
+
+def test_finalize_survives_interpreter_shutdown():
+    # __del__-based teardown could raise during interpreter shutdown
+    # (module globals torn down before the object). weakref.finalize
+    # runs via atexit instead; a subprocess holding live handles at exit
+    # must terminate cleanly with an empty stderr.
+    code = textwrap.dedent("""\
+        import numpy as np
+        from gelly_tpu.utils import native
+
+        if native.compact_session_available():
+            KEEP = native.NativeCompactSession(64)
+            KEEP.assign(np.arange(10, dtype=np.int32))
+        if native.unit_segments_available():
+            B = native.UnitForestBuilder(16)
+            B.add(np.array([0], np.int32), np.array([1], np.int32), None)
+        print("alive")
+    """)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    assert "alive" in proc.stdout
+    assert "Exception ignored" not in proc.stderr, proc.stderr
