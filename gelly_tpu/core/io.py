@@ -149,9 +149,33 @@ class EdgeChunkSource:
     def num_edges(self) -> int:
         return int(self.src_raw.shape[0])
 
+    @property
+    def num_chunks(self) -> int:
+        return -(-self.num_edges // self.chunk_size)
+
     def __iter__(self) -> Iterator[EdgeChunk]:
+        return self.iter_from(0)
+
+    def iter_from(self, chunk_index: int) -> Iterator[EdgeChunk]:
+        """Chunk iterator starting at ``chunk_index`` — the resume seek used
+        by the resilient driver (``engine/resilience.py``).
+
+        A stateful :class:`VertexTable` assigns slots in first-seen stream
+        order, so the skipped prefix is still ENCODED (same per-chunk
+        src-then-dst order as a from-zero run) to warm the table — slot
+        assignment, and hence every downstream summary, stays bit-identical
+        to an uninterrupted run. Re-encoding already-known ids is idempotent,
+        so restarting a partially-consumed source is safe too. Identity
+        tables seek in O(1).
+        """
+        if chunk_index < 0:
+            raise ValueError(f"chunk_index must be >= 0, got {chunk_index}")
+        return self._iter_impl(chunk_index)
+
+    def _iter_impl(self, chunk_index: int) -> Iterator[EdgeChunk]:
         n = self.num_edges
         cs = self.chunk_size
+        start = min(chunk_index * cs, n)
         src_all = dst_all = None
         if isinstance(self.table, IdentityVertexTable):
             # Identity densification is stateless: encode the whole stream
@@ -159,7 +183,12 @@ class EdgeChunkSource:
             # astype was a serial ~ms/chunk cost on the ingest thread).
             src_all = self.table.encode(self.src_raw)
             dst_all = self.table.encode(self.dst_raw)
-        for lo in range(0, n, cs):
+        else:
+            for lo in range(0, start, cs):
+                hi = min(lo + cs, n)
+                self.table.encode(self.src_raw[lo:hi])
+                self.table.encode(self.dst_raw[lo:hi])
+        for lo in range(start, n, cs):
             hi = min(lo + cs, n)
             if src_all is not None:
                 src = src_all[lo:hi]

@@ -126,9 +126,13 @@ class EdgeStream:
     """
 
     def __init__(self, chunks_fn: Callable[[], Iterator[EdgeChunk]],
-                 ctx: StreamContext):
+                 ctx: StreamContext, source=None):
         self._chunks_fn = chunks_fn
         self.ctx = ctx
+        # The underlying seekable EdgeChunkSource when this stream reads one
+        # directly (None for transformed/derived streams): chunks_from then
+        # fast-forwards in O(1) instead of re-iterating the prefix.
+        self.source = source
 
     # ------------------------------------------------------------------ #
     # plumbing
@@ -139,6 +143,17 @@ class EdgeStream:
     def get_edges(self) -> Iterator[EdgeChunk]:
         """The stream of edge chunks (GraphStream.getEdges)."""
         return iter(self)
+
+    def chunks_from(self, position: int) -> Iterator[EdgeChunk]:
+        """Chunk iterator starting at chunk index ``position`` — the resume
+        fast-forward used by ``engine/resilience.py``. Seeks through the
+        underlying source when it supports ``iter_from``; otherwise skips
+        the prefix by iteration (always correct, O(position) on resume)."""
+        if position <= 0:
+            return self._chunks_fn()
+        if self.source is not None and hasattr(self.source, "iter_from"):
+            return self.source.iter_from(position)
+        return itertools.islice(self._chunks_fn(), position, None)
 
     def _mapped(self, fn: Callable[[EdgeChunk], EdgeChunk]) -> "EdgeStream":
         jfn = jax.jit(fn)
@@ -450,7 +465,7 @@ def edge_stream_from_source(source: EdgeChunkSource,
             f"{vertex_capacity}"
         )
     ctx = StreamContext(table=table, vertex_capacity=vertex_capacity)
-    return EdgeStream(lambda: iter(source), ctx)
+    return EdgeStream(lambda: iter(source), ctx, source=source)
 
 
 def edge_stream_from_edges(

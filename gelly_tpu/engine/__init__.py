@@ -4,4 +4,17 @@ from .aggregation import (
     edges_fold_adapter,
     run_aggregation,
 )
-from .checkpoint import load_checkpoint, save_checkpoint
+from .checkpoint import (
+    CheckpointCorruptError,
+    load_checkpoint,
+    save_checkpoint,
+)
+from .resilience import (
+    CheckpointManager,
+    ResilienceConfig,
+    ResilientRunner,
+    RetriesExhausted,
+    RetryPolicy,
+    WatchdogTimeout,
+    resilient_fold,
+)

@@ -9,7 +9,12 @@ atomically; resume reloads the arrays and continues folding from that
 position.
 
 Format: ``.npz`` with flattened leaves + a JSON header describing the pytree
-structure — no pickle, so checkpoints are portable and inspectable.
+structure — no pickle, so checkpoints are portable and inspectable. Format
+version 2 adds a per-leaf CRC32 so a torn or bit-rotted file is detected at
+load (``CheckpointCorruptError``) instead of unflattening garbage into the
+next jit; version-1 files (no ``version`` key) still load, without the CRC
+check. Files claiming a version newer than :data:`CHECKPOINT_VERSION` are
+rejected loudly — schema skew, not corruption.
 """
 
 from __future__ import annotations
@@ -17,23 +22,49 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import zipfile
+import zlib
 from typing import Any
 
 import jax
 import numpy as np
 
+# Bump when the on-disk schema changes incompatibly. v1 = no version key,
+# no CRCs; v2 = per-leaf crc32 list in the header.
+CHECKPOINT_VERSION = 2
+
+# Positions beyond this are nonsense (2^53: exact-integer float range, and
+# far past any real chunk count) — treat as corruption, not data.
+_MAX_POSITION = 1 << 53
+
+
+class CheckpointCorruptError(ValueError):
+    """The checkpoint file is unreadable, torn, or fails validation.
+
+    Subclasses ValueError so pre-existing ``except ValueError`` callers
+    keep working; recovery code (``engine/resilience.py``) catches this to
+    fall back to the previous checkpoint in the rotation.
+    """
+
 
 def save_checkpoint(path: str, summary, position: int = 0,
                     meta: dict | None = None) -> None:
     """Atomically write ``summary`` (any pytree of arrays) + stream position."""
+    if position < 0:
+        raise ValueError(f"checkpoint position must be >= 0, got {position}")
     leaves, treedef = jax.tree.flatten(summary)
+    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     header = {
+        "version": CHECKPOINT_VERSION,
         "treedef": str(treedef),
         "num_leaves": len(leaves),
         "position": int(position),
         "meta": meta or {},
+        "crc32": [
+            zlib.crc32(np.ascontiguousarray(a).tobytes())
+            for a in arrays.values()
+        ],
     }
-    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -49,24 +80,90 @@ def save_checkpoint(path: str, summary, position: int = 0,
         raise
 
 
+def _validate_leaf(i: int, arr: np.ndarray, template, path: str) -> None:
+    t_shape = tuple(np.shape(template))
+    if tuple(arr.shape) != t_shape:
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: leaf {i} has shape {tuple(arr.shape)} but "
+            f"the template expects {t_shape}"
+        )
+    t_dtype = getattr(template, "dtype", None)
+    if t_dtype is not None and np.dtype(arr.dtype) != np.dtype(t_dtype):
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: leaf {i} has dtype {arr.dtype} but the "
+            f"template expects {np.dtype(t_dtype)}"
+        )
+
+
 def load_checkpoint(path: str, like=None):
     """Load a checkpoint. Returns ``(summary, position, meta)``.
 
     ``like`` — a template pytree with the same structure (e.g. ``agg.init()``);
     required to rebuild structured summaries. When None, returns the flat leaf
-    list in saved order.
+    list in saved order. Every leaf is validated against the template's
+    shape/dtype (a same-leaf-count but wrong-shaped checkpoint would
+    otherwise unflatten silently and fail later inside jit) and, for
+    version-2 files, against its stored CRC32. Torn/unparseable files raise
+    :class:`CheckpointCorruptError`.
     """
-    with np.load(path) as z:
-        header = json.loads(bytes(z["__header__"]).decode())
-        leaves = [z[f"leaf_{i}"] for i in range(header["num_leaves"])]
+    try:
+        with np.load(path) as z:
+            header = json.loads(bytes(z["__header__"]).decode())
+            version = header.get("version", 1)
+            if version > CHECKPOINT_VERSION:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path} has format version {version}; this "
+                    f"build reads up to {CHECKPOINT_VERSION} — written by a "
+                    "newer gelly_tpu?"
+                )
+            leaves = [z[f"leaf_{i}"] for i in range(header["num_leaves"])]
+    except FileNotFoundError:
+        raise
+    except CheckpointCorruptError:
+        raise
+    except (zipfile.BadZipFile, KeyError, OSError, ValueError,
+            json.JSONDecodeError, zlib.error, EOFError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is unreadable (torn write?): {e}"
+        ) from e
+    position = header.get("position")
+    if (not isinstance(position, int) or isinstance(position, bool)
+            or position < 0 or position > _MAX_POSITION):
+        raise CheckpointCorruptError(
+            f"checkpoint {path} records position {position!r}; expected an "
+            f"integer in [0, {_MAX_POSITION}]"
+        )
+    crcs = header.get("crc32")
+    if crcs is not None:
+        if len(crcs) != len(leaves):
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: {len(crcs)} CRCs for "
+                f"{len(leaves)} leaves"
+            )
+        for i, (arr, want) in enumerate(zip(leaves, crcs)):
+            got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: leaf {i} CRC mismatch "
+                    f"(stored {want:#010x}, computed {got:#010x}) — "
+                    "corrupt or torn file"
+                )
+    meta = header.get("meta", {})
+    if not isinstance(meta, dict):
+        raise CheckpointCorruptError(
+            f"checkpoint {path} records meta of type "
+            f"{type(meta).__name__}; expected a dict"
+        )
     if like is not None:
-        _, treedef = jax.tree.flatten(like)
+        t_leaves, treedef = jax.tree.flatten(like)
         if treedef.num_leaves != len(leaves):
             raise ValueError(
                 f"checkpoint has {len(leaves)} leaves; template has "
                 f"{treedef.num_leaves}"
             )
+        for i, (arr, tmpl) in enumerate(zip(leaves, t_leaves)):
+            _validate_leaf(i, arr, tmpl, path)
         summary = jax.tree.unflatten(treedef, leaves)
     else:
         summary = leaves
-    return summary, header["position"], header["meta"]
+    return summary, position, meta

@@ -1,0 +1,634 @@
+"""Resilient streaming driver: checkpointed folds, retry, crash recovery.
+
+The reference delegates every one of these responsibilities to Flink
+(``ListCheckpointed`` snapshot/restore, task restarts, backpressure); this
+module re-owns them natively for the ``step(state, chunk) -> (state,
+emission)`` fold contract shared by ``core/stream.py``,
+``engine/aggregation.py`` and ``parallel/sharded_cc.py``:
+
+- **Checkpointing woven into the loop** (:class:`CheckpointManager`):
+  every N chunks and/or T seconds the device state is snapshotted to host
+  and written on a background thread as ``ckpt-<position>.npz`` with
+  per-leaf CRC32 + schema versioning (``engine/checkpoint.py`` v2),
+  keep-last-K rotation. A torn or corrupt newest file is detected at load
+  and the previous one used.
+- **Exactly-once resume** (:meth:`ResilientRunner.run`): on restart the
+  newest *valid* checkpoint is reloaded, the chunk source fast-forwarded to
+  the recorded position (``iter_from``/``chunks_from`` seek when the source
+  supports it, island skip otherwise), and the fold continues — a resumed
+  run produces a bit-identical final state to an uninterrupted run.
+  Emissions for already-folded chunks are not replayed (state is
+  exactly-once; the emission side-channel is at-most-once across a crash).
+- **Bounded retry with exponential backoff + jitter** (:class:`RetryPolicy`)
+  and a **watchdog timeout** (:class:`Watchdog`) around the three fragile
+  boundaries: native ctypes calls (classified by ``utils/native.py``),
+  H2D staging / step dispatch, and checkpoint I/O. A hung call raises
+  :class:`WatchdogTimeout` on the driver thread (the stuck daemon worker is
+  abandoned) and is retried like any transient error; a hung or
+  retry-exhausted CHECKPOINT write degrades instead — the fold continues
+  with durability reduced, aborting only after
+  ``max_checkpoint_failures`` consecutive misses (the end-of-stream
+  checkpoint always surfaces its error).
+- **Graceful degradation**: when a native library keeps erroring mid-stream
+  the driver disables it process-wide (``native.disable``) and switches to
+  the caller-supplied ``fallback_step`` (the numpy path), re-attempting the
+  same chunk — state is functional, so the failed attempt left nothing
+  behind.
+
+Every path above is *driven* in tests by the deterministic fault harness in
+``engine/faults.py`` (``pytest -m faults``), including a kill -9 crash test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+from ..utils import native as native_mod
+from ..utils.prefetch import restartable_prefetch
+from . import faults as faults_mod
+from .checkpoint import (
+    CheckpointCorruptError,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+logger = logging.getLogger("gelly_tpu.resilience")
+
+
+class StreamFault(RuntimeError):
+    """Base class for driver-level failures (always actionable text)."""
+
+
+class RetriesExhausted(StreamFault):
+    """A fragile boundary failed every attempt of its retry budget."""
+
+    def __init__(self, boundary: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"boundary '{boundary}' failed after {attempts} attempts; "
+            f"last error: {type(last).__name__}: {last}"
+        )
+        self.boundary = boundary
+        self.attempts = attempts
+
+
+class WatchdogTimeout(TimeoutError):
+    """A guarded call exceeded the watchdog timeout (treated as transient)."""
+
+    def __init__(self, boundary: str, timeout: float):
+        super().__init__(
+            f"boundary '{boundary}' exceeded the {timeout:.3g}s watchdog "
+            "timeout (hung native call / device transfer?)"
+        )
+        self.boundary = boundary
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with jitter: attempt k (0-based retry) sleeps
+    ``min(base * multiplier**k, max_delay) * (1 + jitter * U[0,1))``.
+
+    ``max_attempts`` counts total tries (first call + retries). Jitter uses
+    the driver's seeded RNG, so schedules are reproducible in tests.
+    """
+
+    max_attempts: int = 4
+    base_delay: float = 0.05
+    multiplier: float = 2.0
+    max_delay: float = 5.0
+    jitter: float = 0.5
+
+    def delay(self, retry_index: int, rng: random.Random) -> float:
+        d = min(self.base_delay * self.multiplier ** retry_index,
+                self.max_delay)
+        return d * (1.0 + self.jitter * rng.random())
+
+
+def default_retryable(exc: BaseException) -> bool:
+    """Is this error worth retrying? Transient: watchdog timeouts, I/O and
+    allocation failures, connection drops, retryable injected faults, and
+    anything ``utils/native.py`` classifies as transient. Data-dependent
+    errors (ValueError slot range, TypeError) are permanent — retrying
+    replays the same failure."""
+    if isinstance(exc, WatchdogTimeout):
+        return True
+    if isinstance(exc, faults_mod.FaultInjected):
+        return exc.retryable
+    if isinstance(exc, FileNotFoundError):
+        return False
+    return native_mod.classify_error(exc) == "transient"
+
+
+class Watchdog:
+    """Run a call with a wall-clock bound, on a disposable daemon thread.
+
+    A hung ctypes call cannot be cancelled from Python; on timeout the
+    worker thread is abandoned (daemon — it cannot block interpreter exit)
+    and :class:`WatchdogTimeout` raises on the caller. ``timeout=None``
+    disables the guard (zero threading overhead)."""
+
+    def __init__(self, timeout: float | None):
+        self.timeout = timeout
+
+    def call(self, fn: Callable[[], Any], boundary: str):
+        if not self.timeout:
+            return fn()
+        box: list = []
+        done = threading.Event()
+
+        def run():
+            try:
+                box.append(("ok", fn()))
+            except BaseException as e:  # re-raised on the caller thread
+                box.append(("err", e))
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=run, daemon=True, name=f"gelly-watchdog-{boundary}"
+        )
+        t.start()
+        if not done.wait(self.timeout):
+            raise WatchdogTimeout(boundary, self.timeout)
+        kind, payload = box[0]
+        if kind == "err":
+            raise payload
+        return payload
+
+
+class CheckpointManager:
+    """Rotated ``ckpt-<position>.npz`` files with async writes.
+
+    ``save`` snapshots device state to host *synchronously* (the state at
+    that position, not whatever the device holds when the writer thread
+    gets scheduled) and hands the file write to a single background worker
+    with at-most-one write in flight — backpressure, not an unbounded
+    queue. Write errors surface at the next ``save``/``flush`` and are
+    retried inside the worker under ``retry``. ``load_latest`` walks the
+    rotation newest-first, skipping torn/corrupt files.
+    """
+
+    def __init__(self, directory: str, keep: int = 3,
+                 retry: RetryPolicy | None = None,
+                 async_write: bool = True, seed: int = 0,
+                 write_timeout: float | None = None):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = directory
+        self.keep = keep
+        self.retry = retry or RetryPolicy()
+        # Watchdog for checkpoint I/O: a hung write surfaces as
+        # WatchdogTimeout at the next flush instead of blocking the fold
+        # loop forever. None = wait indefinitely.
+        self.write_timeout = write_timeout
+        self._rng = random.Random(seed)
+        os.makedirs(directory, exist_ok=True)
+        # A SIGKILL mid-write leaves save_checkpoint's atomic-rename temp
+        # behind; it can never be the newest valid checkpoint (the rename
+        # never happened), so reap it at takeover (single-writer dir).
+        for stale in glob.glob(os.path.join(directory, "*.npz.tmp")):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        self._async = async_write
+        # Single-flight async write: (daemon thread, error box). A daemon
+        # thread (not a ThreadPoolExecutor) so a write hung past the
+        # timeout is abandoned cleanly and can never block interpreter
+        # exit.
+        self._pending: tuple | None = None
+        # Consecutive failed/timed-out writes, reset by any write that
+        # actually completes — the durability gauge the driver's
+        # max_checkpoint_failures bound reads. (An abandoned writer that
+        # eventually finishes resets it too: durability was achieved.)
+        self.consecutive_failures = 0
+
+    def path_for(self, position: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{position:012d}.npz")
+
+    def list(self) -> list[str]:
+        """Checkpoint paths, oldest → newest (position-ordered)."""
+        return sorted(glob.glob(os.path.join(self.directory, "ckpt-*.npz")))
+
+    def save(self, state, position: int, meta: dict | None = None) -> None:
+        host = jax.device_get(state)
+        if not self._async:
+            self._write(host, position, meta)
+            return
+        self.flush()
+        box: list = []
+
+        def writer():
+            try:
+                self._write(host, position, meta)
+            except BaseException as e:  # surfaced at the next flush
+                box.append(e)
+
+        t = threading.Thread(target=writer, daemon=True, name="gelly-ckpt")
+        t.start()
+        self._pending = (t, box)
+
+    def _write(self, host, position: int, meta: dict | None) -> None:
+        try:
+            self._write_inner(host, position, meta)
+        except BaseException:
+            self.consecutive_failures += 1
+            raise
+        self.consecutive_failures = 0
+
+    def _write_inner(self, host, position: int, meta: dict | None) -> None:
+        path = self.path_for(position)
+        attempt = 0
+        while True:
+            try:
+                faults_mod.inject("checkpoint_write", path=path)
+                save_checkpoint(path, host, position=position, meta=meta)
+                break
+            except BaseException as e:
+                attempt += 1
+                if not default_retryable(e):
+                    raise  # permanent (data) error: never a retry problem
+                if attempt >= self.retry.max_attempts:
+                    raise RetriesExhausted(
+                        "checkpoint_write", attempt, e
+                    ) from e
+                time.sleep(self.retry.delay(attempt - 1, self._rng))
+        # Torn-write simulation point: fires AFTER the file is durable so a
+        # corrupt fault produces exactly the artifact load must survive.
+        faults_mod.inject("checkpoint_corrupt", path=path)
+        self._rotate()
+
+    def _rotate(self) -> None:
+        for old in self.list()[:-self.keep]:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+    def flush(self) -> None:
+        """Wait for the in-flight write; re-raises its error, if any. A
+        write still running after ``write_timeout`` raises
+        :class:`WatchdogTimeout` — the daemon writer is abandoned (it can
+        neither block the fold loop again nor interpreter exit)."""
+        if self._pending is not None:
+            (t, box), self._pending = self._pending, None
+            t.join(self.write_timeout)
+            if t.is_alive():
+                # Neither completed nor failed yet — count the miss here
+                # (_write's own accounting runs whenever it finishes).
+                self.consecutive_failures += 1
+                raise WatchdogTimeout("checkpoint_write", self.write_timeout)
+            if box:
+                raise box[0]
+
+    def close(self) -> None:
+        self.flush()
+
+    def load_latest(self, like=None):
+        """Newest valid checkpoint as ``(state, position, meta, path)``, or
+        ``None`` when the rotation holds none. Corrupt/torn files are
+        logged and skipped — the previous checkpoint in the rotation wins.
+        """
+        for path in reversed(self.list()):
+            try:
+                faults_mod.inject("checkpoint_read", path=path)
+                state, position, meta = load_checkpoint(path, like=like)
+                return state, position, meta, path
+            except (CheckpointCorruptError, OSError,
+                    faults_mod.FaultInjected) as e:
+                # Unreadable, torn, or read-I/O-failed (the injected
+                # checkpoint_read fault models the last): fall back.
+                logger.warning(
+                    "checkpoint %s unusable (%s); trying previous", path, e
+                )
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Knobs of :class:`ResilientRunner` (all have production defaults)."""
+
+    checkpoint_every_chunks: int = 64
+    checkpoint_every_seconds: float | None = None
+    keep_checkpoints: int = 3
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    # None disables the watchdog. Applied per guarded call (stage / step /
+    # checkpoint), not to the whole run.
+    watchdog_timeout: float | None = 60.0
+    # Switch to fallback_step (and native.disable the stem, when known)
+    # after this many CONSECUTIVE step failures classified as native.
+    degrade_after: int = 2
+    # Prefetch lookahead for the chunk source; 0 = synchronous pulls.
+    prefetch_depth: int = 2
+    # Source-iterator restarts allowed before the error is fatal.
+    max_source_restarts: int = 3
+    # Mid-stream checkpoint failures (hung write past the watchdog,
+    # exhausted write retries) tolerated before the run aborts: the fold
+    # keeps going with degraded durability, logged per miss. The forced
+    # end-of-stream checkpoint is never tolerated — final state must be
+    # durable.
+    max_checkpoint_failures: int = 3
+    seed: int = 0
+    sleep: Callable[[float], None] = time.sleep
+    clock: Callable[[], float] = time.monotonic
+
+
+def _make_seekable(chunks) -> Callable[[int], Iterator]:
+    """Normalize a chunk source to ``make_iter(position)``.
+
+    Accepts an ``EdgeStream`` (``chunks_from``), a source with ``iter_from``
+    (``core/io.EdgeChunkSource``), a callable ``position -> iterator``, or a
+    plain re-iterable (islice skip — correct, just O(position) on restart).
+    A single-shot iterator is accepted for one pass but any restart/re-open
+    raises :class:`StreamFault` instead of silently re-reading an exhausted
+    stream."""
+    import itertools
+
+    if callable(chunks) and not hasattr(chunks, "__iter__"):
+        return chunks
+    if hasattr(chunks, "chunks_from"):
+        return chunks.chunks_from
+    if hasattr(chunks, "iter_from"):
+        return chunks.iter_from
+    if iter(chunks) is chunks:
+        # Single-shot iterator (generator): it can be opened exactly once —
+        # a restart or a second resume attempt would silently re-read an
+        # exhausted stream and "succeed" with missing data. Allow the one
+        # open; fail LOUDLY on any re-open.
+        opened = [False]
+
+        def make_once(position: int) -> Iterator:
+            if opened[0]:
+                raise StreamFault(
+                    "chunk source is a single-shot iterator and was already "
+                    "consumed; source restart/resume needs a seekable or "
+                    "re-iterable source (EdgeStream, EdgeChunkSource, a "
+                    "callable position -> iterator, or a list)"
+                )
+            opened[0] = True
+            return itertools.islice(chunks, position, None)
+
+        return make_once
+
+    def make_iter(position: int) -> Iterator:
+        return itertools.islice(iter(chunks), position, None)
+
+    return make_iter
+
+
+class ResilientRunner:
+    """Drive ``step(state, chunk) -> (state, emission)`` to completion,
+    surviving transient failures and process death.
+
+    ``chunks`` — an ``EdgeStream``, ``EdgeChunkSource``, callable
+    ``position -> iterator``, or plain iterable. ``init_state`` — the
+    initial state pytree or a zero-arg factory (also the resume template).
+    ``stage(chunk) -> chunk`` — optional H2D/pre-processing hook, guarded
+    as the ``"h2d"`` boundary. ``fallback_step`` — the numpy-path step the
+    driver degrades to when native keeps failing.
+
+    ``run()`` returns the final state; ``emissions()`` yields
+    ``(position, emission)`` for every non-None emission as it happens.
+    """
+
+    def __init__(
+        self,
+        step: Callable[[Any, Any], tuple[Any, Any]],
+        chunks,
+        init_state,
+        *,
+        checkpoint_dir: str | None = None,
+        resume: bool = True,
+        config: ResilienceConfig | None = None,
+        stage: Callable[[Any], Any] | None = None,
+        fallback_step: Callable[[Any, Any], tuple[Any, Any]] | None = None,
+        meta: dict | None = None,
+    ):
+        self._step = step
+        self._make_iter = _make_seekable(chunks)
+        self._init_state = init_state
+        self._resume = resume
+        self.config = config or ResilienceConfig()
+        self._stage = stage
+        self._fallback_step = fallback_step
+        self._meta = dict(meta or {})
+        self._rng = random.Random(self.config.seed)
+        self._watchdog = Watchdog(self.config.watchdog_timeout)
+        self._native_failures = 0
+        self._degraded = False
+        self.manager = None
+        if checkpoint_dir is not None:
+            self.manager = CheckpointManager(
+                checkpoint_dir,
+                keep=self.config.keep_checkpoints,
+                retry=self.config.retry,
+                seed=self.config.seed,
+                write_timeout=self.config.watchdog_timeout,
+            )
+        self.position = 0  # chunks folded into the current state
+        self.stats = {
+            "chunks": 0, "retries": 0, "checkpoints": 0,
+            "checkpoint_failures": 0, "restarts": 0,
+            "resumed_from": None, "degraded": False,
+        }
+
+    # ------------------------------------------------------------------ #
+    # guarded calls
+
+    def _guard(self, boundary: str, fn: Callable[[], Any]):
+        """Retry ``fn`` under the watchdog with exponential backoff."""
+        policy = self.config.retry
+        attempt = 0
+
+        def guarded():
+            # Injection runs INSIDE the watchdog guard: a kind="hang" fault
+            # must be caught by the timeout exactly like a real hung call.
+            faults_mod.inject(boundary)
+            return fn()
+
+        while True:
+            try:
+                return self._watchdog.call(guarded, boundary)
+            except BaseException as e:
+                attempt += 1
+                if boundary == "step" and self._maybe_degrade(e):
+                    # Same chunk re-attempted on the fallback path; the
+                    # failed attempt left no state behind (step is pure).
+                    continue
+                if not default_retryable(e):
+                    raise
+                if attempt >= policy.max_attempts:
+                    raise RetriesExhausted(boundary, attempt, e) from e
+                self.stats["retries"] += 1
+                delay = policy.delay(attempt - 1, self._rng)
+                logger.warning(
+                    "boundary '%s' attempt %d/%d failed (%s: %s); "
+                    "retrying in %.3fs", boundary, attempt,
+                    policy.max_attempts, type(e).__name__, e, delay,
+                )
+                self.config.sleep(delay)
+
+    def _maybe_degrade(self, exc: BaseException) -> bool:
+        """Degradation ladder: repeated native step errors switch the fold
+        to the numpy fallback (and disable the native stem process-wide so
+        codec probes stop choosing it). Returns True when the step was
+        swapped and the chunk should be re-attempted immediately."""
+        if self._degraded or self._fallback_step is None:
+            return False
+        if native_mod.classify_native(exc) is None:
+            return False
+        self._native_failures += 1
+        if self._native_failures < self.config.degrade_after:
+            return False
+        stem = getattr(exc, "stem", None)
+        if stem:
+            native_mod.disable(stem, reason=f"degraded mid-stream: {exc}")
+        logger.warning(
+            "native step failed %d consecutive times (%s: %s); degrading "
+            "to the numpy fallback fold", self._native_failures,
+            type(exc).__name__, exc,
+        )
+        self._step = self._fallback_step
+        self._degraded = True
+        self.stats["degraded"] = True
+        return True
+
+    # ------------------------------------------------------------------ #
+    # the fold loop
+
+    def _initial_state(self):
+        state = (self._init_state()
+                 if callable(self._init_state) else self._init_state)
+        if self.manager is not None and self._resume:
+            found = self.manager.load_latest(like=state)
+            if found is not None:
+                state, self.position, meta, path = found
+                state = jax.tree.map(np.asarray, state)
+                self._meta.update(
+                    {k: v for k, v in meta.items() if k not in self._meta}
+                )
+                self.stats["resumed_from"] = path
+                logger.info(
+                    "resuming from %s at chunk %d", path, self.position
+                )
+        return state
+
+    def emissions(self) -> Iterator[tuple[int, Any]]:
+        """Run the fold; yield ``(position, emission)`` for each non-None
+        emission. The final state is left in ``self.state``."""
+        cfg = self.config
+        state = self._initial_state()
+        self.state = state
+        start = self.position
+        last_ckpt_pos = start
+        last_ckpt_time = cfg.clock()
+
+        def should_restart(exc: BaseException) -> bool:
+            ok = default_retryable(exc)
+            if ok:
+                self.stats["restarts"] += 1
+                logger.warning(
+                    "chunk source failed (%s: %s); restarting at chunk %d",
+                    type(exc).__name__, exc, self.position,
+                )
+            return ok
+
+        def source_iter(pos: int) -> Iterator:
+            faults_mod.inject("source")
+            return self._make_iter(pos)
+
+        chunk_iter = restartable_prefetch(
+            source_iter,
+            depth=cfg.prefetch_depth,
+            start=start,
+            max_restarts=cfg.max_source_restarts,
+            should_restart=should_restart,
+            position=lambda: self.position,
+        )
+        try:
+            for chunk in chunk_iter:
+                if self._stage is not None:
+                    chunk = self._guard(
+                        "h2d", lambda c=chunk: self._stage(c)
+                    )
+                state, emission = self._guard(
+                    "step", lambda s=state, c=chunk: self._step(s, c)
+                )
+                # The degrade ladder counts CONSECUTIVE native failures; a
+                # chunk that eventually folded clean resets it.
+                self._native_failures = 0
+                self.state = state
+                self.position += 1
+                self.stats["chunks"] = self.position - start
+                if emission is not None:
+                    yield self.position, emission
+                if self.manager is not None:
+                    due = (
+                        self.position - last_ckpt_pos
+                        >= cfg.checkpoint_every_chunks
+                    )
+                    if not due and cfg.checkpoint_every_seconds is not None:
+                        due = (cfg.clock() - last_ckpt_time
+                               >= cfg.checkpoint_every_seconds)
+                    if due:
+                        self._checkpoint(state)
+                        last_ckpt_pos = self.position
+                        last_ckpt_time = cfg.clock()
+            if self.manager is not None:
+                if self.position > last_ckpt_pos:
+                    self._checkpoint(state, final=True)
+                self.manager.close()
+        except BaseException:
+            # Leave the newest durable checkpoint in place for the next
+            # incarnation; just stop the writer cleanly.
+            if self.manager is not None:
+                try:
+                    self.manager.close()
+                except BaseException:
+                    logger.exception("checkpoint writer shutdown failed")
+            raise
+
+    def _checkpoint(self, state, final: bool = False) -> None:
+        """Cadenced snapshot. A failed MID-STREAM checkpoint (hung write,
+        exhausted write retries) degrades durability but must not kill an
+        otherwise healthy fold — tolerated up to ``max_checkpoint_failures``
+        consecutive misses; the end-of-stream checkpoint always raises."""
+        try:
+            self.manager.save(
+                state, self.position,
+                meta={**self._meta, "wall_time": time.time()},
+            )
+        except (WatchdogTimeout, RetriesExhausted):
+            self.stats["checkpoint_failures"] += 1
+            consecutive = self.manager.consecutive_failures
+            if final or consecutive >= self.config.max_checkpoint_failures:
+                raise
+            logger.error(
+                "checkpoint at position %d failed (%d consecutive miss(es),"
+                " tolerating up to %d); durability degraded, fold continues",
+                self.position, consecutive,
+                self.config.max_checkpoint_failures,
+            )
+            return
+        self.stats["checkpoints"] += 1
+
+    def run(self):
+        """Drain the stream; return the final state pytree."""
+        for _ in self.emissions():
+            pass
+        return self.state
+
+
+def resilient_fold(step, chunks, init_state, **kw):
+    """Functional shorthand: run :class:`ResilientRunner` to completion and
+    return the final state."""
+    return ResilientRunner(step, chunks, init_state, **kw).run()

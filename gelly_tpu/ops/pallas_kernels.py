@@ -27,6 +27,18 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+# Compat shim: the x64-toggle context manager lives at jax.enable_x64 on
+# newer jax and jax.experimental.enable_x64/disable_x64 on 0.4.x.
+if hasattr(jax, "enable_x64"):
+    def _x64_mode(enabled: bool):
+        return jax.enable_x64(enabled)
+else:  # jax 0.4.x
+    from jax.experimental import disable_x64 as _disable_x64
+    from jax.experimental import enable_x64 as _enable_x64
+
+    def _x64_mode(enabled: bool):
+        return _enable_x64() if enabled else _disable_x64()
+
 TILE = 128  # MXU native tile edge
 
 
@@ -52,7 +64,7 @@ def wedge_count_matrix(m: jax.Array, interpret: bool = False) -> jax.Array:
     # The framework traces with x64 on (64-bit id space); Mosaic rejects the
     # i64 grid indices that leak into the index maps, so trace the kernel
     # itself in 32-bit mode — nothing here needs 64-bit.
-    with jax.enable_x64(False):
+    with _x64_mode(False):
         return pl.pallas_call(
             _wedge_kernel,
             out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),

@@ -37,6 +37,70 @@ _NATIVE_DIR = os.path.abspath(
 _lock = threading.Lock()
 _libs: dict = {}
 
+# Fault-injection hook: ``engine/faults.install`` points this at the active
+# plan's "native" boundary (a plain attribute write — utils never imports
+# engine, so no dependency cycle). Checked at every ctypes entry point;
+# None when no plan is installed.
+_fault_hook = None
+
+# Stems disabled at runtime (the resilient driver's degradation ladder, or
+# an operator override): available() reports them unavailable, so every
+# codec/plan probe falls back to the pure-numpy path.
+_DISABLED: dict[str, str] = {}
+
+
+def _inject(stem: str) -> None:
+    hook = _fault_hook
+    if hook is not None:
+        hook(stem)
+
+
+def disable(stem: str, reason: str = "") -> None:
+    """Force ``available(stem)`` False process-wide (numpy fallback)."""
+    _AVAILABLE[stem] = False
+    _DISABLED[stem] = reason or "disabled"
+
+
+def reenable(stem: str) -> None:
+    """Undo :func:`disable`; the next ``available()`` re-probes."""
+    _AVAILABLE.pop(stem, None)
+    _DISABLED.pop(stem, None)
+
+
+def disabled_reason(stem: str) -> str | None:
+    return _DISABLED.get(stem)
+
+
+# Retryable-error classification for the resilient driver: allocation and
+# I/O failures are environment pressure (transient — backoff and retry);
+# ValueError-class failures are data-dependent (permanent — the same chunk
+# will fail the same way forever).
+_TRANSIENT_TYPES = (MemoryError, OSError, ConnectionError, TimeoutError)
+
+
+def classify_error(exc: BaseException) -> str:
+    """``"transient"`` (worth retrying with backoff) or ``"permanent"``."""
+    return "transient" if isinstance(exc, _TRANSIENT_TYPES) else "permanent"
+
+
+def classify_native(exc: BaseException) -> str | None:
+    """The native component stem an error is attributable to, or None for
+    errors that did not originate in a native binding. Errors raised by the
+    wrappers here carry a ``.stem`` attribute; injected faults carry their
+    boundary."""
+    stem = getattr(exc, "stem", None)
+    if stem is not None:
+        return str(stem)
+    if getattr(exc, "boundary", None) == "native":
+        return "unknown"
+    return None
+
+
+def _stamp(exc: BaseException, stem: str) -> BaseException:
+    """Attach the originating stem so classify_native can attribute it."""
+    exc.stem = stem
+    return exc
+
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 
@@ -296,6 +360,7 @@ def spanner_chunk_fold(src: np.ndarray, dst: np.ndarray,
     ``meta[1]``. Raises on slot range errors or output-list overflow.
     ctypes releases the GIL during the call.
     """
+    _inject("spanner")
     lib = _load_spanner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -314,11 +379,14 @@ def spanner_chunk_fold(src: np.ndarray, dst: np.ndarray,
         _as_i32p(out_src), _as_i32p(out_dst), out_src.shape[0],
     )
     if rc == 3:
-        raise ValueError(
+        raise _stamp(ValueError(
             "spanner edge list overflowed; raise max_edges"
-        )
+        ), "spanner")
     if rc != 0:
-        raise ValueError(f"spanner_chunk_fold: bad vertex slot (rc={rc})")
+        raise _stamp(
+            ValueError(f"spanner_chunk_fold: bad vertex slot (rc={rc})"),
+            "spanner",
+        )
 
 
 def _load_matching() -> ctypes.CDLL:
@@ -347,6 +415,7 @@ def matching_chunk_fold(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     type 0 = ADD, 1 = REMOVE; otherwise returns None. ctypes releases the
     GIL during the call.
     """
+    _inject("matching")
     lib = _load_matching()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -378,9 +447,15 @@ def matching_chunk_fold(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
         *ev_args, cap, ctypes.byref(count),
     )
     if rc == 3:
-        raise ValueError("matching_chunk_fold: event buffer overflow")
+        raise _stamp(
+            ValueError("matching_chunk_fold: event buffer overflow"),
+            "matching",
+        )
     if rc != 0:
-        raise ValueError(f"matching_chunk_fold: bad vertex slot (rc={rc})")
+        raise _stamp(
+            ValueError(f"matching_chunk_fold: bad vertex slot (rc={rc})"),
+            "matching",
+        )
     if want_events:
         k = count.value
         return ev_type[:k], ev_a[:k], ev_b[:k], ev_w[:k]
@@ -395,6 +470,7 @@ def cc_chunk_combine(src: np.ndarray, dst: np.ndarray,
     ctypes releases the GIL during the call, so combiner work for different
     chunks can overlap on a thread pool.
     """
+    _inject("chunk_combiner")
     lib = _load_combiner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -407,13 +483,16 @@ def cc_chunk_combine(src: np.ndarray, dst: np.ndarray,
         _as_i32p(src), _as_i32p(dst), vp, src.shape[0], n_v, _as_i32p(labels)
     )
     if rc != 0:
-        raise ValueError(f"cc_chunk_combine: vertex slot out of range (rc={rc})")
+        raise _stamp(ValueError(
+            f"cc_chunk_combine: vertex slot out of range (rc={rc})"
+        ), "chunk_combiner")
     return labels
 
 
 def parity_chunk_combine(src: np.ndarray, dst: np.ndarray,
                          valid: np.ndarray | None, n_v: int):
     """(labels i32[n_v], parity u8[n_v], conflict bool) of one chunk."""
+    _inject("chunk_combiner")
     lib = _load_combiner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -429,9 +508,9 @@ def parity_chunk_combine(src: np.ndarray, dst: np.ndarray,
         _as_i32p(labels), parity.ctypes.data_as(_u8p), ctypes.byref(conflict),
     )
     if rc != 0:
-        raise ValueError(
+        raise _stamp(ValueError(
             f"parity_chunk_combine: vertex slot out of range (rc={rc})"
-        )
+        ), "chunk_combiner")
     return labels, parity, bool(conflict.value)
 
 
@@ -444,6 +523,7 @@ def degree_chunk_deltas(src: np.ndarray, dst: np.ndarray,
     ``event`` (i8, 1 = deletion) and ``valid`` may be None (all additions /
     all valid). ctypes releases the GIL during the call.
     """
+    _inject("chunk_combiner")
     lib = _load_combiner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -461,19 +541,22 @@ def degree_chunk_deltas(src: np.ndarray, dst: np.ndarray,
         int(count_out), int(count_in), _as_i32p(out),
     )
     if rc != 0:
-        raise ValueError(
+        raise _stamp(ValueError(
             f"degree_chunk_deltas: vertex slot out of range (rc={rc})"
-        )
+        ), "chunk_combiner")
     return out
 
 
 def _sparse_rc_check(rc: int, fn: str) -> None:
     if rc == -2:
-        raise ValueError(f"{fn}: vertex slot out of range")
+        raise _stamp(ValueError(f"{fn}: vertex slot out of range"),
+                     "chunk_combiner")
     if rc == -3:
-        raise ValueError(f"{fn}: pair capacity overflow")
+        raise _stamp(ValueError(f"{fn}: pair capacity overflow"),
+                     "chunk_combiner")
     if rc < 0:
-        raise MemoryError(f"{fn}: allocation failed (rc={rc})")
+        raise _stamp(MemoryError(f"{fn}: allocation failed (rc={rc})"),
+                     "chunk_combiner")
 
 
 def cc_chunk_combine_sparse(src: np.ndarray, dst: np.ndarray,
@@ -482,6 +565,7 @@ def cc_chunk_combine_sparse(src: np.ndarray, dst: np.ndarray,
     the touched-slot codec (payload ∝ touched vertices, never n_v).
     Returns ``(verts i32[t], roots i32[t])``. GIL released during the call.
     """
+    _inject("chunk_combiner")
     lib = _load_combiner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -530,6 +614,7 @@ def cc_unit_forest_segments(src: np.ndarray, dst: np.ndarray,
     component's ROOT first in its segment (the device fold derives the
     root-row index of every pair as its segment start, so the pair wire
     is 4 bytes/member instead of 8). GIL released during the call."""
+    _inject("chunk_combiner")
     lib = _load_combiner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -563,7 +648,8 @@ class UnitForestBuilder:
         self._block = int(block)
         self._h = self._lib.cc_unit_begin()
         if not self._h:
-            raise MemoryError("cc_unit_begin failed")
+            raise _stamp(MemoryError("cc_unit_begin failed"),
+                         "chunk_combiner")
         # weakref.finalize instead of __del__: it runs at most once, pins
         # the ctypes function + handle it needs, and fires via atexit
         # before module globals are torn down — so interpreter-shutdown
@@ -624,7 +710,8 @@ class NativeCompactSession:
         self._capacity = int(capacity)
         self._h = self._lib.compact_session_create(self._capacity)
         if not self._h:
-            raise MemoryError("compact_session_create failed")
+            raise _stamp(MemoryError("compact_session_create failed"),
+                         "chunk_combiner")
         # Same finalize-over-__del__ rationale as UnitForestBuilder.
         self._finalize = weakref.finalize(
             self, self._lib.compact_session_destroy, self._h
@@ -669,7 +756,10 @@ class NativeCompactSession:
         )
         if base == -4:
             self._poison()
-            raise MemoryError("compact_session_assign: allocation failed")
+            raise _stamp(
+                MemoryError("compact_session_assign: allocation failed"),
+                "chunk_combiner",
+            )
         if base == -2:
             # Native-side backstop of the validation above.
             raise ValueError("compact_session_assign: negative vertex id")
@@ -710,7 +800,10 @@ class NativeCompactSession:
             # A failed rehash leaves the probe table inconsistent with
             # the restored vert_of — discard the session.
             self._poison()
-            raise MemoryError("compact_session_rebuild: allocation failed")
+            raise _stamp(
+                MemoryError("compact_session_rebuild: allocation failed"),
+                "chunk_combiner",
+            )
 
 
 def cc_chunk_combine_sparse_idx(src: np.ndarray, dst: np.ndarray,
@@ -720,6 +813,7 @@ def cc_chunk_combine_sparse_idx(src: np.ndarray, dst: np.ndarray,
     vertex, i.e. ``verts[ri[j]] == roots[j]``: the device fold resolves a
     pair's root side by indexing its own chased array instead of a second
     pointer chase. GIL released during the call."""
+    _inject("chunk_combiner")
     lib = _load_combiner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -743,6 +837,7 @@ def parity_chunk_combine_sparse(src: np.ndarray, dst: np.ndarray,
                                 valid: np.ndarray | None, n_v: int):
     """Counted (vertex, root, parity) triples + chunk odd-cycle flag.
     Returns ``(verts i32[t], roots i32[t], parity u8[t], conflict bool)``."""
+    _inject("chunk_combiner")
     lib = _load_combiner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -771,6 +866,7 @@ def degree_chunk_deltas_sparse(src: np.ndarray, dst: np.ndarray,
                                count_in: bool = True):
     """Counted (vertex, net-delta) pairs of one chunk (zero net deltas
     omitted). Returns ``(verts i32[t], deltas i32[t])``."""
+    _inject("chunk_combiner")
     lib = _load_combiner()
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
@@ -795,6 +891,7 @@ def degree_chunk_deltas_sparse(src: np.ndarray, dst: np.ndarray,
 
 def parse_edge_list_file(path: str, want_vals: bool = False):
     """(src[i64], dst[i64][, val[f64]]) numpy arrays from an edge-list file."""
+    _inject("edgelist_parser")
     lib = _load()
     src_p = ctypes.POINTER(ctypes.c_int64)()
     dst_p = ctypes.POINTER(ctypes.c_int64)()
@@ -807,7 +904,10 @@ def parse_edge_list_file(path: str, want_vals: bool = False):
     if rc == 1:
         raise FileNotFoundError(path)
     if rc != 0:
-        raise MemoryError(f"native parser failed with code {rc}")
+        raise _stamp(
+            MemoryError(f"native parser failed with code {rc}"),
+            "edgelist_parser",
+        )
     count = n.value
     try:
         src = np.ctypeslib.as_array(src_p, (count,)).copy() if count else \

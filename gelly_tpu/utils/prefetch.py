@@ -74,7 +74,8 @@ def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
                     if cancel.is_set():
                         break
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, daemon=True,
+                         name="gelly-prefetch")
     t.start()
     try:
         while True:
@@ -82,6 +83,10 @@ def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
             if item is _DONE:
                 return
             if isinstance(item, _Error):
+                # Re-raising the captured exception keeps the worker's
+                # original traceback (exc.__traceback__, set when the
+                # worker caught it) chained under the consumer's frame —
+                # asserted by test_prefetch_preserves_worker_traceback.
                 raise item.exc
             yield item
     finally:
@@ -140,7 +145,8 @@ def prefetch_map(fn, it: Iterable, depth: int = 2,
                     if cancel.is_set():
                         break
 
-    t = threading.Thread(target=submitter, daemon=True)
+    t = threading.Thread(target=submitter, daemon=True,
+                         name="gelly-prefetch-submit")
     t.start()
     try:
         while True:
@@ -148,8 +154,59 @@ def prefetch_map(fn, it: Iterable, depth: int = 2,
             if got is _DONE:
                 return
             if isinstance(got, _Error):
-                raise got.exc
+                raise got.exc  # worker traceback preserved (see prefetch)
             yield got.result()  # re-raises fn's exception in order
     finally:
         cancel.set()
         pool.shutdown(wait=False, cancel_futures=True)
+
+
+def restartable_prefetch(make_iter, depth: int = 2, *, start: int = 0,
+                         max_restarts: int = 3, should_restart=None,
+                         position=None, on_restart=None) -> Iterator:
+    """Prefetch that survives source/worker failure by reopening the source.
+
+    ``make_iter(i)`` must return a fresh iterator positioned at item ``i``
+    (items are numbered from 0; ``start`` is the first index pulled). When
+    iteration raises and ``should_restart(exc)`` returns True, the dead
+    prefetch pipeline (worker thread included) is torn down and a new one
+    opened at the next undelivered index — items already yielded are never
+    re-yielded, items that were only sitting in the prefetch queue are
+    re-read from the source. After ``max_restarts`` restarts (or a
+    non-restartable error) the exception propagates with its original
+    traceback.
+
+    ``position`` — optional zero-arg callable reporting the consumer's own
+    index of the next item it needs; when given it overrides the internal
+    delivered count at restart (useful when the consumer tracks progress
+    authoritatively, e.g. the resilient fold driver's chunk position).
+    """
+    delivered = start
+    restarts = 0
+    while True:
+        it = None
+        while True:
+            try:
+                # make_iter runs inside the try: an error OPENING the
+                # source (seek failure, injected source fault) restarts
+                # like any mid-stream error.
+                if it is None:
+                    it = prefetch(make_iter(delivered), depth)
+                item = next(it)
+            except StopIteration:
+                return
+            except BaseException as e:
+                restarts += 1
+                if (should_restart is not None and not should_restart(e)) \
+                        or restarts > max_restarts:
+                    raise
+                if position is not None:
+                    delivered = position()
+                if on_restart is not None:
+                    on_restart(e, delivered)
+                break  # reopen the source at ``delivered``
+            # The yield sits OUTSIDE the try: a consumer-side throw (incl.
+            # GeneratorExit on close) must propagate, never trigger a
+            # source restart.
+            yield item
+            delivered += 1

@@ -1,0 +1,73 @@
+"""Subprocess body for the kill -9 crash-recovery test (test_resilience.py).
+
+Runs a checkpointed resilient CC fold over a deterministic random edge
+stream. The parent SIGKILLs this process mid-stream on the first run, then
+re-runs it; the second incarnation resumes from the newest valid checkpoint
+and must write a final summary bit-identical to an uninterrupted run.
+
+argv: <checkpoint_dir> <out_npz> [chunk_sleep_seconds]
+Env: GELLY_CRASH_EDGES / _NV / _CHUNK override the stream shape.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from gelly_tpu import edge_stream_from_edges  # noqa: E402
+from gelly_tpu.engine.checkpoint import save_checkpoint  # noqa: E402
+from gelly_tpu.engine.resilience import (  # noqa: E402
+    ResilienceConfig,
+    ResilientRunner,
+)
+from gelly_tpu.library.connected_components import (  # noqa: E402
+    connected_components,
+)
+
+N_EDGES = int(os.environ.get("GELLY_CRASH_EDGES", "2048"))
+N_V = int(os.environ.get("GELLY_CRASH_NV", "128"))
+CHUNK = int(os.environ.get("GELLY_CRASH_CHUNK", "32"))
+
+
+def build_stream():
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(0, N_V, (N_EDGES, 2))
+    edges = [(int(a), int(b)) for a, b in pairs]
+    return edge_stream_from_edges(
+        edges, vertex_capacity=N_V, chunk_size=CHUNK
+    )
+
+
+def main(argv):
+    ckpt_dir, out_path = argv[0], argv[1]
+    sleep_s = float(argv[2]) if len(argv) > 2 else 0.0
+    agg = connected_components(N_V)
+    fold = jax.jit(agg.fold)
+
+    def step(s, c):
+        if sleep_s:
+            time.sleep(sleep_s)
+        return fold(s, c), None
+
+    runner = ResilientRunner(
+        step,
+        build_stream(),
+        agg.init,
+        checkpoint_dir=ckpt_dir,
+        config=ResilienceConfig(
+            checkpoint_every_chunks=4, watchdog_timeout=None
+        ),
+    )
+    final = jax.device_get(runner.run())
+    # Reuse the checkpoint writer as the result format (CRC-verified load
+    # in the parent).
+    save_checkpoint(out_path, final, position=runner.position)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

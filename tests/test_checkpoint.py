@@ -1,10 +1,18 @@
 """Checkpoint/resume: the summary IS the checkpoint payload (SURVEY.md §5)."""
 
+import json
+import zlib
+
 import numpy as np
 import pytest
 
 from gelly_tpu import edge_stream_from_edges
-from gelly_tpu.engine.checkpoint import load_checkpoint, save_checkpoint
+from gelly_tpu.engine.checkpoint import (
+    CHECKPOINT_VERSION,
+    CheckpointCorruptError,
+    load_checkpoint,
+    save_checkpoint,
+)
 from gelly_tpu.library.connected_components import (
     connected_components,
     labels_to_components,
@@ -153,3 +161,104 @@ def test_resume_midstream_codec_batched_plan(tmp_path):
         agg, checkpoint_path=p, resume=True, **kw
     ).result()
     assert labels_to_components(final, s2.ctx) == want
+
+
+# ---------------------------------------------------------------------- #
+# v2 hardening: CRC32, schema versioning, template validation
+
+
+def _rewrite_header(path, mutate):
+    """Load a checkpoint npz, apply ``mutate(header_dict, arrays)``, rewrite."""
+    with np.load(path) as z:
+        header = json.loads(bytes(z["__header__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__header__"}
+    mutate(header, arrays)
+    with open(path, "wb") as f:
+        np.savez(f, __header__=np.frombuffer(
+            json.dumps(header).encode(), dtype=np.uint8
+        ), **arrays)
+
+
+def test_load_rejects_wrong_leaf_shape(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": np.zeros(8, np.int32)}, position=1)
+    with pytest.raises(CheckpointCorruptError, match="shape"):
+        load_checkpoint(p, like={"a": np.zeros(16, np.int32)})
+
+
+def test_load_rejects_wrong_leaf_dtype(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": np.zeros(8, np.int32)}, position=1)
+    with pytest.raises(CheckpointCorruptError, match="dtype"):
+        load_checkpoint(p, like={"a": np.zeros(8, np.int64)})
+
+
+def test_load_rejects_bad_position(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": np.zeros(4)}, position=3)
+    for bad in (-5, 2 ** 60, "7", None):
+        _rewrite_header(
+            p, lambda h, a, b=bad: h.__setitem__("position", b)
+        )
+        with pytest.raises(CheckpointCorruptError, match="position"):
+            load_checkpoint(p)
+    with pytest.raises(ValueError, match="position"):
+        save_checkpoint(p, {"a": np.zeros(4)}, position=-1)
+
+
+def test_load_detects_bitrot_via_crc(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": np.arange(32, dtype=np.int64)}, position=2)
+
+    def flip(h, arrays):
+        arrays["leaf_0"] = arrays["leaf_0"].copy()
+        arrays["leaf_0"][5] ^= 1  # single bit flip, shape/dtype intact
+    _rewrite_header(p, flip)
+    with pytest.raises(CheckpointCorruptError, match="CRC"):
+        load_checkpoint(p)
+
+
+def test_load_detects_torn_file(tmp_path):
+    import os
+
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": np.arange(1024, dtype=np.int64)}, position=2)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(CheckpointCorruptError, match="torn"):
+        load_checkpoint(p)
+
+
+def test_load_rejects_future_version(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": np.zeros(4)}, position=0)
+    _rewrite_header(
+        p, lambda h, a: h.__setitem__("version", CHECKPOINT_VERSION + 1)
+    )
+    with pytest.raises(CheckpointCorruptError, match="version"):
+        load_checkpoint(p)
+
+
+def test_v1_checkpoint_without_crc_still_loads(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": np.arange(4, dtype=np.int32)}, position=5)
+
+    def strip_v2(h, a):
+        del h["version"]
+        del h["crc32"]
+    _rewrite_header(p, strip_v2)
+    loaded, pos, _ = load_checkpoint(
+        p, like={"a": np.zeros(4, np.int32)}
+    )
+    assert pos == 5
+    np.testing.assert_array_equal(loaded["a"], np.arange(4, dtype=np.int32))
+
+
+def test_crc_roundtrip_matches_manual(tmp_path):
+    p = str(tmp_path / "c.npz")
+    arr = np.arange(16, dtype=np.float32)
+    save_checkpoint(p, [arr], position=0)
+    with np.load(p) as z:
+        header = json.loads(bytes(z["__header__"]).decode())
+    assert header["version"] == CHECKPOINT_VERSION
+    assert header["crc32"] == [zlib.crc32(arr.tobytes())]

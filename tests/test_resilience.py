@@ -1,0 +1,578 @@
+"""Resilient driver: checkpointed folds, retry/backoff, watchdog, fault
+injection, degradation, and kill -9 crash recovery (``pytest -m faults``)."""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gelly_tpu.engine import faults
+from gelly_tpu.engine.checkpoint import load_checkpoint
+from gelly_tpu.engine.resilience import (
+    CheckpointManager,
+    ResilienceConfig,
+    ResilientRunner,
+    RetriesExhausted,
+    RetryPolicy,
+    Watchdog,
+    WatchdogTimeout,
+)
+from gelly_tpu.utils import native
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------- #
+# a tiny order-sensitive fold: state' = state * 3 + chunk. Any skipped,
+# duplicated, or reordered chunk changes the final value, so equality with
+# an uninterrupted run is an exactly-once proof.
+
+
+def _step(s, c):
+    return np.int64(s * 3 + c), int(c)
+
+
+def _clean_run(n):
+    s = np.int64(0)
+    for c in range(n):
+        s, _ = _step(s, c)
+    return s
+
+
+def _fast(**kw):
+    kw.setdefault("retry", RetryPolicy(max_attempts=4, base_delay=0.01,
+                                       max_delay=0.05))
+    kw.setdefault("watchdog_timeout", None)
+    kw.setdefault("prefetch_depth", 2)
+    return ResilienceConfig(**kw)
+
+
+# ---------------------------------------------------------------------- #
+# units
+
+
+def test_retry_policy_backoff_and_determinism():
+    p = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5, jitter=0.5)
+    d0 = [p.delay(i, random.Random(7)) for i in range(5)]
+    d1 = [p.delay(i, random.Random(7)) for i in range(5)]
+    assert d0 == d1  # seeded jitter is reproducible
+    bases = [0.1, 0.2, 0.4, 0.5, 0.5]
+    for d, b in zip(d0, bases):
+        assert b <= d <= b * 1.5  # exponential growth, capped, jitter-bounded
+
+
+def test_watchdog_passes_results_and_errors_and_times_out():
+    w = Watchdog(timeout=5.0)
+    assert w.call(lambda: 42, "t") == 42
+    with pytest.raises(KeyError):
+        w.call(lambda: {}["x"], "t")
+    w = Watchdog(timeout=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(WatchdogTimeout):
+        w.call(lambda: time.sleep(3.0), "t")
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_checkpoint_manager_rotation_and_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2, async_write=False)
+    for pos in (2, 4, 6, 8):
+        mgr.save(np.int64(pos * 10), pos)
+    files = mgr.list()
+    assert [os.path.basename(f) for f in files] == [
+        "ckpt-000000000006.npz", "ckpt-000000000008.npz"
+    ]
+    state, pos, _, path = mgr.load_latest(like=np.int64(0))
+    assert pos == 8 and int(state) == 80 and path == files[-1]
+
+
+def test_checkpoint_manager_skips_torn_newest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=3, async_write=False)
+    mgr.save(np.int64(1), 1)
+    mgr.save(np.int64(2), 2)
+    newest = mgr.list()[-1]
+    with open(newest, "r+b") as f:  # tear the newest file
+        f.truncate(os.path.getsize(newest) // 2)
+    state, pos, _, path = mgr.load_latest(like=np.int64(0))
+    assert pos == 1 and int(state) == 1 and path != newest
+
+
+def test_checkpoint_manager_async_write_error_surfaces(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path), keep=2,
+        retry=RetryPolicy(max_attempts=2, base_delay=0.01),
+    )
+    plan = faults.FaultPlan([
+        faults.Fault("checkpoint_write", at=0, count=10,
+                     exc=lambda: PermissionError("disk said no")),
+    ])
+    with faults.install(plan):
+        mgr.save(np.int64(5), 5)
+        with pytest.raises(RetriesExhausted) as ei:
+            mgr.close()
+    assert ei.value.boundary == "checkpoint_write"
+
+
+# ---------------------------------------------------------------------- #
+# driver: retry / watchdog / degradation at each boundary
+
+
+def test_transient_step_fault_is_retried_to_success():
+    plan = faults.FaultPlan([faults.Fault("step", at=3, count=2)])
+    with faults.install(plan):
+        r = ResilientRunner(_step, list(range(10)), np.int64(0),
+                            config=_fast())
+        final = r.run()
+    assert int(final) == int(_clean_run(10))
+    assert r.stats["retries"] == 2
+    assert plan.fired == [("step", 3, "raise"), ("step", 4, "raise")]
+
+
+def test_permanent_fault_is_not_retried():
+    plan = faults.FaultPlan([
+        faults.Fault("step", at=2, retryable=False),
+    ])
+    with faults.install(plan):
+        r = ResilientRunner(_step, list(range(10)), np.int64(0),
+                            config=_fast())
+        with pytest.raises(faults.FaultInjected):
+            r.run()
+    assert r.stats["retries"] == 0
+
+
+def test_retries_exhausted_is_actionable():
+    plan = faults.FaultPlan([faults.Fault("step", at=1, count=50)])
+    with faults.install(plan):
+        r = ResilientRunner(_step, list(range(10)), np.int64(0),
+                            config=_fast())
+        with pytest.raises(RetriesExhausted) as ei:
+            r.run()
+    assert ei.value.boundary == "step"
+    assert "attempts" in str(ei.value)
+
+
+def test_hang_hits_watchdog_and_is_retried():
+    plan = faults.FaultPlan([
+        faults.Fault("step", at=2, kind="hang", hang_seconds=10.0),
+    ])
+    t0 = time.monotonic()
+    with faults.install(plan):
+        r = ResilientRunner(_step, list(range(6)), np.int64(0),
+                            config=_fast(watchdog_timeout=0.2))
+        final = r.run()
+    assert time.monotonic() - t0 < 5.0  # did not sit out the 10s hang
+    assert int(final) == int(_clean_run(6))
+    assert r.stats["retries"] == 1
+
+
+def test_h2d_boundary_fault_is_retried():
+    staged = []
+    plan = faults.FaultPlan([faults.Fault("h2d", at=1, count=1)])
+    with faults.install(plan):
+        r = ResilientRunner(
+            _step, list(range(5)), np.int64(0), config=_fast(),
+            stage=lambda c: (staged.append(c), c)[1],
+        )
+        final = r.run()
+    assert int(final) == int(_clean_run(5))
+    assert r.stats["retries"] == 1
+    assert staged == list(range(5))  # retried chunk staged exactly once more
+
+
+def test_native_boundary_fires_through_hook():
+    if not native.available("chunk_combiner"):
+        pytest.skip("native chunk_combiner unavailable")
+    src = np.array([0, 1], np.int32)
+    dst = np.array([1, 2], np.int32)
+
+    def step(s, c):
+        labels = native.cc_chunk_combine(src, dst, None, 4)
+        return np.int64(s + labels[0] + c), None
+
+    plan = faults.FaultPlan([faults.Fault("native", at=1, count=1)])
+    with faults.install(plan):
+        r = ResilientRunner(step, list(range(4)), np.int64(0),
+                            config=_fast())
+        r.run()
+    assert plan.calls("native") >= 4
+    assert r.stats["retries"] == 1
+
+
+def test_repeated_native_errors_degrade_to_fallback():
+    def boom():
+        e = MemoryError("native alloc failed")
+        e.stem = "fake_stem"
+        return e
+
+    calls = {"native": 0, "fallback": 0}
+
+    def native_step(s, c):
+        calls["native"] += 1
+        faults.inject("native")
+        return _step(s, c)
+
+    def fallback_step(s, c):
+        calls["fallback"] += 1
+        return _step(s, c)
+
+    plan = faults.FaultPlan([
+        faults.Fault("native", at=2, count=100, exc=boom),
+    ])
+    try:
+        with faults.install(plan):
+            r = ResilientRunner(
+                native_step, list(range(8)), np.int64(0),
+                config=_fast(degrade_after=2),
+                fallback_step=fallback_step,
+            )
+            final = r.run()
+        assert int(final) == int(_clean_run(8))
+        assert r.stats["degraded"] is True
+        assert calls["fallback"] == 6  # chunks 2..7 on the numpy path
+        assert native.disabled_reason("fake_stem") is not None
+        assert not native.available("fake_stem") \
+            if "fake_stem" in native._AVAILABLE else True
+    finally:
+        native.reenable("fake_stem")
+
+
+def test_source_failure_restarts_without_loss():
+    fails = {"n": 0}
+
+    def make_iter(pos):
+        def gen():
+            for i in range(pos, 12):
+                if i == 7 and fails["n"] == 0:
+                    fails["n"] = 1
+                    raise OSError("source hiccup")
+                yield i
+        return gen()
+
+    r = ResilientRunner(_step, make_iter, np.int64(0), config=_fast())
+    emitted = [c for _, c in r.emissions()]
+    assert emitted == list(range(12))  # no loss, no duplicates
+    assert int(r.state) == int(_clean_run(12))
+    assert r.stats["restarts"] == 1
+
+
+def test_checkpoint_write_fault_retried_inside_manager(tmp_path):
+    plan = faults.FaultPlan([
+        faults.Fault("checkpoint_write", at=0, count=1,
+                     exc=lambda: OSError("EIO")),
+    ])
+    with faults.install(plan):
+        r = ResilientRunner(
+            _step, list(range(6)), np.int64(0),
+            checkpoint_dir=str(tmp_path),
+            config=_fast(checkpoint_every_chunks=2),
+        )
+        final = r.run()
+    assert int(final) == int(_clean_run(6))
+    _, pos, _ = load_checkpoint(
+        os.path.join(tmp_path, "ckpt-000000000006.npz"), like=np.int64(0)
+    )
+    assert pos == 6
+
+
+def test_time_based_checkpoint_cadence(tmp_path):
+    fake = {"t": 0.0}
+
+    def step_tick(s, c):
+        fake["t"] += 1.0  # each chunk "takes" one fake second
+        return _step(s, c)
+
+    r = ResilientRunner(
+        step_tick, list(range(9)), np.int64(0),
+        checkpoint_dir=str(tmp_path),
+        config=_fast(
+            checkpoint_every_chunks=10 ** 9,  # count cadence never fires
+            checkpoint_every_seconds=3.0,
+            clock=lambda: fake["t"],
+        ),
+    )
+    final = r.run()
+    assert int(final) == int(_clean_run(9))
+    # T-second cadence: checkpoints at fake-times 3, 6, 9 → positions
+    # 3/6/9, plus the forced end-of-stream write is already position 9.
+    mgr = CheckpointManager(str(tmp_path))
+    positions = [int(os.path.basename(p)[5:-4]) for p in mgr.list()]
+    assert positions == [3, 6, 9]
+
+
+def test_hung_checkpoint_write_degrades_then_recovers(tmp_path):
+    # ONE hung write: the fold must tolerate the missed checkpoint (log +
+    # continue, durability degraded) and finish with the final state
+    # durable — a healthy multi-hour run must not die for one slow disk.
+    plan = faults.FaultPlan([
+        faults.Fault("checkpoint_write", at=1, kind="hang",
+                     hang_seconds=10.0),
+    ])
+    t0 = time.monotonic()
+    with faults.install(plan):
+        r = ResilientRunner(
+            _step, list(range(10)), np.int64(0),
+            checkpoint_dir=str(tmp_path),
+            config=_fast(checkpoint_every_chunks=2, watchdog_timeout=0.3),
+        )
+        final = r.run()
+    assert time.monotonic() - t0 < 5.0  # never sat out the 10s hang
+    assert int(final) == int(_clean_run(10))
+    assert r.stats["checkpoint_failures"] == 1
+    mgr = CheckpointManager(str(tmp_path))
+    state, pos, _, _ = mgr.load_latest(like=np.int64(0))
+    assert pos == 10  # end-of-stream checkpoint is durable
+
+
+def test_persistently_hung_checkpoint_writes_abort(tmp_path):
+    # EVERY write hangs: after max_checkpoint_failures consecutive misses
+    # the run aborts with the watchdog error instead of silently folding
+    # on with no durability at all.
+    plan = faults.FaultPlan([
+        faults.Fault("checkpoint_write", at=1, count=10 ** 6, kind="hang",
+                     hang_seconds=10.0),
+    ])
+    t0 = time.monotonic()
+    with faults.install(plan):
+        r = ResilientRunner(
+            _step, list(range(40)), np.int64(0),
+            checkpoint_dir=str(tmp_path),
+            config=_fast(checkpoint_every_chunks=2, watchdog_timeout=0.2,
+                         max_checkpoint_failures=2),
+        )
+        with pytest.raises(WatchdogTimeout) as ei:
+            r.run()
+    assert ei.value.boundary == "checkpoint_write"
+    assert time.monotonic() - t0 < 8.0
+    assert r.stats["checkpoint_failures"] == 2
+
+
+def test_checkpoint_read_fault_falls_back_to_previous(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    mgr.save(np.int64(1), 1)
+    mgr.save(np.int64(2), 2)
+    plan = faults.FaultPlan([faults.Fault("checkpoint_read", at=0)])
+    with faults.install(plan):
+        state, pos, _, _ = mgr.load_latest(like=np.int64(0))
+    assert pos == 1 and int(state) == 1  # newest unreadable -> previous
+
+
+# ---------------------------------------------------------------------- #
+# exactly-once resume
+
+
+def _interrupt_then_resume(tmp_path, n, crash_at, **runner_kw):
+    """Run with a hard (non-retryable) fault at chunk ``crash_at``, then
+    resume a fresh runner; returns (resumed_runner, final_state)."""
+    plan = faults.FaultPlan([
+        faults.Fault("step", at=crash_at, count=100, retryable=False),
+    ])
+    with faults.install(plan):
+        r1 = ResilientRunner(
+            _step, list(range(n)), np.int64(0),
+            checkpoint_dir=str(tmp_path),
+            config=_fast(checkpoint_every_chunks=3), **runner_kw,
+        )
+        with pytest.raises(faults.FaultInjected):
+            r1.run()
+    r2 = ResilientRunner(
+        _step, list(range(n)), np.int64(0),
+        checkpoint_dir=str(tmp_path),
+        config=_fast(checkpoint_every_chunks=3), **runner_kw,
+    )
+    return r2, r2.run()
+
+
+def test_resume_is_bit_identical_to_uninterrupted(tmp_path):
+    r2, final = _interrupt_then_resume(tmp_path, n=20, crash_at=11)
+    assert r2.stats["resumed_from"] is not None
+    assert r2.stats["chunks"] < 20  # genuinely skipped the folded prefix
+    want = _clean_run(20)
+    assert int(final) == int(want)
+    assert np.asarray(final).dtype == want.dtype
+
+
+def test_resume_survives_torn_newest_checkpoint(tmp_path):
+    plan = faults.FaultPlan([
+        faults.Fault("step", at=11, count=100, retryable=False),
+        # Tear every checkpoint written from the 3rd on — the newest files
+        # on disk at crash time are garbage; resume must walk back to the
+        # last intact one.
+        faults.Fault("checkpoint_corrupt", at=2, count=100, kind="corrupt"),
+    ])
+    with faults.install(plan):
+        r1 = ResilientRunner(
+            _step, list(range(20)), np.int64(0),
+            checkpoint_dir=str(tmp_path),
+            config=_fast(checkpoint_every_chunks=2, keep_checkpoints=4),
+        )
+        with pytest.raises(faults.FaultInjected):
+            r1.run()
+    r2 = ResilientRunner(
+        _step, list(range(20)), np.int64(0),
+        checkpoint_dir=str(tmp_path), config=_fast(),
+    )
+    final = r2.run()
+    assert int(final) == int(_clean_run(20))
+
+
+def test_resume_with_edge_stream_cc_fold(tmp_path):
+    """The real contract: a jitted CC fold over an EdgeStream, interrupted
+    and resumed, matches the uninterrupted summary bit-for-bit."""
+    import jax
+
+    from gelly_tpu import edge_stream_from_edges
+    from gelly_tpu.library.connected_components import connected_components
+
+    rng = np.random.default_rng(3)
+    edges = [(int(a), int(b)) for a, b in rng.integers(0, 64, (512, 2))]
+
+    def stream():
+        return edge_stream_from_edges(edges, vertex_capacity=64,
+                                      chunk_size=16)
+
+    agg = connected_components(64)
+    fold = jax.jit(agg.fold)
+    step = lambda s, c: (fold(s, c), None)  # noqa: E731
+
+    clean = ResilientRunner(step, stream(), agg.init, config=_fast()).run()
+
+    plan = faults.FaultPlan([
+        faults.Fault("step", at=20, count=100, retryable=False),
+    ])
+    with faults.install(plan):
+        r1 = ResilientRunner(
+            step, stream(), agg.init, checkpoint_dir=str(tmp_path),
+            config=_fast(checkpoint_every_chunks=4),
+        )
+        with pytest.raises(faults.FaultInjected):
+            r1.run()
+    r2 = ResilientRunner(
+        step, stream(), agg.init, checkpoint_dir=str(tmp_path),
+        config=_fast(checkpoint_every_chunks=4),
+    )
+    resumed = r2.run()
+    assert r2.stats["resumed_from"] is not None
+    for a, b in zip(jax.tree.leaves(clean), jax.tree.leaves(resumed)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+# ---------------------------------------------------------------------- #
+# kill -9 crash recovery (subprocess)
+
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_crash_child.py")
+
+
+def _spawn_child(ckpt_dir, out, sleep_s):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # single default CPU device is enough
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(ckpt_dir), str(out), str(sleep_s)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def test_kill9_recovery_bit_identical(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    out_resumed = tmp_path / "resumed.npz"
+    out_clean = tmp_path / "clean.npz"
+
+    # Uninterrupted reference run (no checkpointing, full speed).
+    clean_dir = tmp_path / "ckpt_clean"
+    p = _spawn_child(clean_dir, out_clean, 0.0)
+    assert p.wait(timeout=300) == 0
+
+    # Run 1: throttled so checkpoints land mid-stream; SIGKILL once at
+    # least two checkpoints exist (the newest might be mid-write).
+    p = _spawn_child(ckpt, out_resumed, 0.05)
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if p.poll() is not None:
+            pytest.fail(f"child exited early (rc={p.returncode}) before kill")
+        ckpts = sorted(ckpt.glob("ckpt-*.npz"))
+        if len(ckpts) >= 2:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("no checkpoints appeared before the deadline")
+    os.kill(p.pid, signal.SIGKILL)
+    assert p.wait(timeout=60) == -signal.SIGKILL
+    assert not out_resumed.exists()  # truly died mid-stream
+    import _crash_child
+
+    total_chunks = _crash_child.build_stream().source.num_chunks
+    top = int(sorted(ckpt.glob("ckpt-*.npz"))[-1].stem.split("-")[1])
+    assert top < total_chunks  # checkpointed position is mid-stream
+
+    # Run 2: same command, resumes from the newest valid checkpoint.
+    p = _spawn_child(ckpt, out_resumed, 0.0)
+    assert p.wait(timeout=300) == 0
+
+    resumed, pos_r, _ = load_checkpoint(str(out_resumed))
+    clean, pos_c, _ = load_checkpoint(str(out_clean))
+    assert pos_r == pos_c == total_chunks
+    assert len(resumed) == len(clean)
+    for a, b in zip(resumed, clean):
+        assert a.tobytes() == b.tobytes()  # bit-identical summary
+
+
+# ---------------------------------------------------------------------- #
+# review regressions
+
+
+def test_single_shot_iterator_restart_fails_loudly():
+    # A generator source can be folded once, but a source restart must NOT
+    # silently re-read the exhausted iterator and "succeed" with data
+    # missing — it raises an actionable StreamFault instead.
+    from gelly_tpu.engine.resilience import StreamFault
+
+    def gen():
+        yield from range(5)
+
+    r = ResilientRunner(_step, gen(), np.int64(0), config=_fast())
+    assert int(r.run()) == int(_clean_run(5))  # one pass works
+
+    def gen_flaky():
+        yield 0
+        yield 1
+        raise OSError("transient mid-stream")
+
+    r2 = ResilientRunner(_step, gen_flaky(), np.int64(0), config=_fast())
+    with pytest.raises(StreamFault, match="single-shot"):
+        r2.run()
+
+
+def test_load_latest_survives_header_meta_damage(tmp_path):
+    # Header damage around the 'meta' key must never escape as a raw
+    # KeyError/TypeError from load_latest: a MISSING meta is benign (the
+    # CRC-verified payload is intact — load with {}), a WRONG-TYPED meta
+    # is corruption (fall back to the previous checkpoint).
+    import json
+
+    def rewrite(path, mutate):
+        with np.load(path) as z:
+            header = json.loads(bytes(z["__header__"]).decode())
+            arrays = {k: z[k] for k in z.files if k != "__header__"}
+        mutate(header)
+        with open(path, "wb") as f:
+            np.savez(f, __header__=np.frombuffer(
+                json.dumps(header).encode(), dtype=np.uint8
+            ), **arrays)
+
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    mgr.save(np.int64(1), 1)
+    mgr.save(np.int64(2), 2)
+    newest = mgr.list()[-1]
+
+    rewrite(newest, lambda h: h.pop("meta"))
+    state, pos, meta, _ = mgr.load_latest(like=np.int64(0))
+    assert pos == 2 and int(state) == 2 and meta == {}
+
+    rewrite(newest, lambda h: h.__setitem__("meta", "garbage"))
+    state, pos, _, _ = mgr.load_latest(like=np.int64(0))
+    assert pos == 1 and int(state) == 1  # fell back, no raw exception

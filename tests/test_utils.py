@@ -161,3 +161,152 @@ def test_native_parser_overflow_reads_as_malformed(tmp_path):
         (9223372036854775807, 6),  # INT64_MAX parses
         (-9223372036854775808, 7),  # INT64_MIN parses (one past MAX)
     ]
+
+
+def test_prefetch_preserves_worker_traceback():
+    # The consumer-side re-raise must carry the SOURCE frame that failed,
+    # not just the prefetch internals (satellite of the resilience PR).
+    def gen():
+        yield 1
+        boom_line_marker = 1 / 0  # noqa: F841
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    import traceback
+
+    try:
+        next(it)
+    except ZeroDivisionError as e:
+        frames = traceback.extract_tb(e.__traceback__)
+        assert any("boom_line_marker" in (f.line or "") for f in frames)
+    else:
+        raise AssertionError("expected ZeroDivisionError")
+
+
+def test_prefetch_error_while_queue_full():
+    # The source raises while the bounded queue is full and the consumer is
+    # slow: the error wrapper must still get through (polling put), and the
+    # already-queued items must be delivered first (order preserved).
+    import time
+
+    def gen():
+        yield from range(4)
+        raise RuntimeError("late failure")
+
+    it = prefetch(gen(), depth=1)
+    got = []
+    time.sleep(0.3)  # let the worker fill the queue and hit the error path
+    with pytest.raises(RuntimeError, match="late failure"):
+        for x in it:
+            got.append(x)
+            time.sleep(0.05)  # keep the queue full behind us
+    assert got == [0, 1, 2, 3]
+
+
+def test_prefetch_cancel_while_queue_full():
+    # Abandon the consumer while the queue is full; the worker must notice
+    # the cancel and exit instead of blocking forever on its put.
+    import threading
+    import time
+
+    def workers():
+        # Only OUR named worker threads: asserting on the global
+        # active_count() would flake when an unrelated runtime thread
+        # (jax backend, another test's abandoned daemon) appears.
+        return [t for t in threading.enumerate()
+                if t.name.startswith("gelly-prefetch") and t.is_alive()]
+
+    before = set(workers())
+    pulled = []
+
+    def gen():
+        for i in range(10_000):
+            pulled.append(i)
+            yield i
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 0
+    it.close()  # GeneratorExit -> finally -> cancel.set()
+    deadline = time.monotonic() + 5.0
+    while (set(workers()) - before) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not (set(workers()) - before)
+    assert len(pulled) < 100  # worker stopped pulling from the source
+
+
+def test_prefetch_map_error_while_queue_full():
+    import time
+
+    from gelly_tpu.utils.prefetch import prefetch_map
+
+    def src():
+        yield from range(4)
+        raise RuntimeError("submitter failure")
+
+    it = prefetch_map(lambda x: x * 2, src(), depth=1, workers=2)
+    got = []
+    time.sleep(0.3)
+    with pytest.raises(RuntimeError, match="submitter failure"):
+        for x in it:
+            got.append(x)
+            time.sleep(0.05)
+    assert got == [0, 2, 4, 6]
+
+
+def test_restartable_prefetch_reopens_at_next_undelivered():
+    from gelly_tpu.utils.prefetch import restartable_prefetch
+
+    opens = []
+    fail_once = {"armed": True}
+
+    def make_iter(pos):
+        opens.append(pos)
+
+        def gen():
+            for i in range(pos, 10):
+                if i == 6 and fail_once["armed"]:
+                    fail_once["armed"] = False
+                    raise OSError("flaky source")
+                yield i
+
+        return gen()
+
+    out = list(restartable_prefetch(make_iter, depth=3,
+                                    should_restart=lambda e: True))
+    assert out == list(range(10))  # exactly once each
+    assert opens[0] == 0 and len(opens) == 2
+    # The restart reopened at the next UNDELIVERED index — nothing lost
+    # even though the queue held prefetched items when the worker died.
+    assert opens[1] <= 6
+
+
+def test_restartable_prefetch_bounded_restarts():
+    from gelly_tpu.utils.prefetch import restartable_prefetch
+
+    def make_iter(pos):
+        def gen():
+            yield pos
+            raise OSError("always down")
+
+        return gen()
+
+    it = restartable_prefetch(make_iter, depth=1, max_restarts=3,
+                              should_restart=lambda e: True)
+    with pytest.raises(OSError, match="always down"):
+        list(it)
+
+
+def test_restartable_prefetch_respects_should_restart():
+    from gelly_tpu.utils.prefetch import restartable_prefetch
+
+    def make_iter(pos):
+        def gen():
+            yield from range(pos, 3)
+            raise ValueError("permanent")
+
+        return gen()
+
+    it = restartable_prefetch(make_iter, depth=1,
+                              should_restart=lambda e: False)
+    with pytest.raises(ValueError, match="permanent"):
+        list(it)
