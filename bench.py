@@ -25,6 +25,59 @@ import time
 
 import numpy as np
 
+# Every stdout JSON line is collected here and written to bench_out.json
+# at process exit (see write_bench_artifact): the committed artifact then
+# carries the FULL line set of a run, so README figures can cite a file
+# in the repo instead of a quote — the headline line still prints LAST on
+# stdout for the driver's last-line parser.
+_BENCH_LINES: list = []
+
+
+def emit(obj: dict) -> dict:
+    """Print a workload line to stdout AND record it for bench_out.json."""
+    _BENCH_LINES.append(obj)
+    print(json.dumps(obj))
+    return obj
+
+
+def write_bench_artifact(workload: str, path: str | None = None) -> None:
+    """Write the run's collected line set next to bench.py.
+
+    Only a full run (``--workload all``) writes the canonical
+    ``bench_out.json`` — a single-workload invocation must not clobber
+    the committed full line set, so it lands in ``bench_out.partial.json``
+    instead. ``captured.chip`` records what actually ran: figures
+    captured on ``cpu`` (reduced sizes, interpret-mode kernels) are
+    structural stand-ins; the perf claims cite v5e captures
+    (BENCH_r0*.json or a TPU-host bench_out.json).
+    """
+    import os
+
+    if path is None:
+        path = "bench_out.json" if workload == "all" else (
+            "bench_out.partial.json")
+
+    try:
+        peaks = chip_peaks()
+    except Exception:  # noqa: BLE001 — artifact must land even headless
+        peaks = {"chip": "unknown"}
+    out = {
+        "schema": 1,
+        "captured": {
+            "workload": workload,
+            "argv": sys.argv[1:],
+            "chip": peaks.get("chip"),
+            "unix_time": int(time.time()),
+        },
+        "lines": _BENCH_LINES,
+    }
+    target = os.path.join(os.path.dirname(os.path.abspath(__file__)), path)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, target)
+
 
 def chip_peaks() -> dict:
     """Peak numbers for the attached accelerator (roofline denominators).
@@ -355,6 +408,10 @@ def _stage_raw_chunks(src, dst, chunk_size: int, max_edges: int):
     import jax
 
     n_use = min(src.shape[0], max_edges)
+    # A stream shorter than one chunk (reduced-size captures) stages as
+    # a single whole-stream chunk instead of zero chunks; an EMPTY
+    # stream must not zero the divisor.
+    chunk_size = min(chunk_size, max(n_use, 1))
     n_use -= n_use % chunk_size  # whole chunks only: static shapes
     k = n_use // chunk_size
     s = jax.device_put(
@@ -402,27 +459,125 @@ def _device_bound_eps(fold_chunk, transform, init_state, staged,
     return n_use / dt
 
 
+def gather_study_block(n_v: int = 1 << 24, lanes: int = 1 << 22) -> dict:
+    """The random-touch roofline study (the device fold's honest wall).
+
+    Measures, on the attached device, the primitives the union-find fold
+    is built from — so the recorded artifact can say WHERE the wall is
+    rather than quote one end-to-end number:
+
+    - ``xla_random_gather_mps`` — ``table[idx]``, uniform random idx: the
+      ~140M touches/s element-granule HBM wall every chase/hook pays.
+    - ``xla_sorted_gather_mps`` — same gather, pre-sorted idx: does XLA
+      exploit locality on its own? (It lowers the same gather either
+      way; this line proves it.)
+    - ``pallas_sorted_gather_mps`` — the VMEM-blocked one-hot-MXU kernel
+      (:func:`gelly_tpu.ops.pallas_kernels.sorted_window_gather`) on the
+      same sorted idx: the achievable blocked random-touch rate.
+    - ``pallas_blocked_roundtrip_mps`` — sort + kernel + unsort
+      (:func:`~gelly_tpu.ops.pallas_kernels.blocked_gather`): what an
+      UNSORTED gather costs when routed through the kernel — profitable
+      only when two sorts undercut the random touches they replace.
+    - ``sort_pairs_mlanes_ps`` — the 2-operand ``lax.sort`` rate: the
+      regular-op currency the sort-dedup design spends.
+    - ``xla_scatter_min_mps`` — the masked scatter-min hook rate.
+
+    Off-TPU the kernels run interpreted (grid steps execute serially in
+    Python), so shapes shrink and ``platform`` records that the numbers
+    are structural only.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_tpu.ops import pallas_kernels as pk
+    from gelly_tpu.ops.segments import masked_scatter_min
+
+    tpu = pk.on_tpu()
+    if not tpu:
+        n_v = min(n_v, 1 << 18)
+        lanes = min(lanes, 1 << 13)
+    rng = np.random.default_rng(23)
+    table = jax.device_put(rng.integers(0, n_v, n_v).astype(np.int32))
+    ridx = jax.device_put(rng.integers(0, n_v, lanes).astype(np.int32))
+    sidx = jax.device_put(np.sort(np.asarray(ridx)).astype(np.int32))
+    jax.block_until_ready((table, ridx, sidx))
+
+    def rate(fn, *args, repeats: int = 3) -> float:
+        f = jax.jit(fn)
+        float(f(*args))  # compile + drain (scalar D2H barrier)
+        dt = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(f(*args))
+            dt = min(dt, time.perf_counter() - t0)
+        return lanes / dt / 1e6
+
+    out = {
+        "gather_table_slots": n_v,
+        "gather_lanes": lanes,
+        "gather_platform": "tpu" if tpu else "cpu-interpret",
+        "xla_random_gather_mps": round(
+            rate(lambda t, i: jnp.max(t[i]), table, ridx), 1),
+        "xla_sorted_gather_mps": round(
+            rate(lambda t, i: jnp.max(t[i]), table, sidx), 1),
+        "sort_pairs_mlanes_ps": round(
+            rate(lambda a, b: jnp.max(
+                jax.lax.sort((a, b), num_keys=1)[0]), ridx, ridx), 1),
+        "xla_scatter_min_mps": round(
+            rate(lambda t, i: jnp.max(masked_scatter_min(
+                t, i, jnp.zeros_like(i), jnp.ones(i.shape, bool))),
+                table, ridx), 1),
+    }
+    try:
+        out["pallas_sorted_gather_mps"] = round(
+            rate(lambda t, i: jnp.max(pk.sorted_window_gather(t, i)),
+                 table, sidx), 1)
+        out["pallas_blocked_roundtrip_mps"] = round(
+            rate(lambda t, i: jnp.max(pk.blocked_gather(t, i)),
+                 table, ridx), 1)
+    except Exception as e:  # noqa: BLE001 — study must land regardless
+        out["pallas_gather_error"] = f"{type(e).__name__}: {e}"[:300]
+    return out
+
+
 def device_bound_cc_eps(src, dst, n_v: int, chunk_size: int,
                         max_edges: int = 1 << 25,
-                        parity_out: dict | None = None) -> float:
+                        parity_out: dict | None = None,
+                        fold_backend: str = "xla",
+                        oracle: np.ndarray | None = None) -> float:
     """Device-resident CC rate: per-chunk raw union-find fold + label
     merge, HBM-staged input (the codec exists only because of the ingest
     link). Large chunks use the sort-dedup kernel
     (:func:`gelly_tpu.ops.unionfind.union_edges_dedup`, VERDICT r4
     item 4); ``parity_out`` receives an exact final-label check against
-    the chunked numpy oracle on the same staged prefix."""
+    the chunked numpy oracle on the same staged prefix (``oracle`` skips
+    recomputing it when the caller already has the full-prefix labels).
+    ``fold_backend`` selects the dedup fold's chase kernel (the
+    ``fold_backend=`` plan knob): ``"pallas"`` = the VMEM-blocked sorted
+    gather for the lo-endpoint chases."""
     import jax.numpy as jnp
 
     from gelly_tpu.library.connected_components import RAW_DEDUP_MIN_CHUNK
     from gelly_tpu.ops import segments, unionfind
 
+    chunk_size = min(chunk_size, max(src.shape[0], 1), max(max_edges, 1))
+    # Whether the timed fold actually runs the sort-dedup kernel (and so
+    # whether a fold_backend= sweep leg exercised its backend at all):
+    # reduced captures can clamp the chunk below the dedup threshold,
+    # and a parity 'pass' from the generic path must not read as kernel
+    # coverage.
+    dedup_engaged = chunk_size >= RAW_DEDUP_MIN_CHUNK
+    if parity_out is not None:
+        parity_out["device_fold_dedup_engaged"] = dedup_engaged
+
     def fold_chunk(state, cs, cd):
         parent, seen = state
         ok = jnp.ones(cs.shape, bool)
-        if chunk_size >= RAW_DEDUP_MIN_CHUNK:
+        if dedup_engaged:
             parent = unionfind.union_edges_dedup(
                 parent, cs, cd, ok,
                 unique_cap=max(1 << 20, 3 * (chunk_size >> 4)),
+                backend=fold_backend,
             )
         else:
             parent = unionfind.union_edges(parent, cs, cd, ok)
@@ -464,20 +619,22 @@ def device_bound_cc_eps(src, dst, n_v: int, chunk_size: int,
             return transform(state)
 
         ours = np.asarray(run_labels(init, s, d))
-        pv, pr = [], []
-        step = 1 << 22
-        for lo in range(0, n_use, step):
-            a, b = cc_pairs_numpy(src[lo:lo + step], dst[lo:lo + step],
-                                  None, n_v)
-            pv.append(a)
-            pr.append(b)
-        oracle = cc_labels_numpy(
-            np.concatenate(pv).astype(np.int32),
-            np.concatenate(pr).astype(np.int32), None, n_v,
-        )
+        if oracle is None:
+            pv, pr = [], []
+            step = 1 << 22
+            for lo in range(0, n_use, step):
+                a, b = cc_pairs_numpy(src[lo:lo + step], dst[lo:lo + step],
+                                      None, n_v)
+                pv.append(a)
+                pr.append(b)
+            oracle = cc_labels_numpy(
+                np.concatenate(pv).astype(np.int32),
+                np.concatenate(pr).astype(np.int32), None, n_v,
+            )
         parity_out["device_fold_parity"] = (
             "pass" if np.array_equal(ours, oracle) else "FAIL"
         )
+        parity_out["device_fold_oracle"] = oracle
     return eps
 
 
@@ -599,7 +756,129 @@ def codec_scaling_block(src, dst, n_v: int, chunk: int,
                     list(ex.map(agg.host_compress, chunks))
             dt = min(dt, time.perf_counter() - t0)
         rates[str(w)] = round(n / dt, 1)
-    return {"ingest_workers": avail, "codec_workers_eps": rates}
+    # In-process THREAD row (one point per available core); the
+    # subprocess K-sweep with fixed K ∈ {1,2,4} is codec_workers_eps
+    # (codec_workers_block).
+    return {"ingest_workers": avail, "codec_threads_eps": rates}
+
+
+# Shared by the forked codec workers (fork = copy-on-write: no pickling
+# of the multi-GB edge arrays; same precedent as baseline_cc_multicore).
+_CW: dict = {}
+
+
+def _codec_worker_main(worker_id: int, workers: int, n_chunks: int,
+                       chunk: int, q) -> None:
+    from gelly_tpu.utils import native as nat
+
+    src, dst, n_v = _CW["src"], _CW["dst"], _CW["n_v"]
+    for ci in range(worker_id, n_chunks, workers):
+        lo = ci * chunk
+        v, r = nat.cc_chunk_combine_sparse(
+            src[lo:lo + chunk], dst[lo:lo + chunk], None, n_v
+        )
+        q.put((v, r))
+    q.put(None)
+
+
+def codec_workers_block(src, dst, n_v: int, chunk: int,
+                        ks=(1, 2, 4), cap_edges: int = 1 << 24) -> dict:
+    """Multi-worker codec scaling points (the deployment equation's
+    measured side): K compressor SUBPROCESSES — fork, own interpreter,
+    own combiner hash tables — each compressing every K-th chunk and
+    feeding the (vertex, root) pair payloads through a queue to ONE
+    consumer (this process), exactly the pipeline's shape. On a host
+    with fewer cores than K the workers timeshare (oversubscribed is
+    fine): the points then bound, rather than exhibit, linear scaling —
+    ``host_cores`` rides along so readers can tell which regime a
+    capture is in. Falls back to K threads (the native combiner releases
+    the GIL) when fork is unavailable, recording the mode.
+    """
+    import multiprocessing as mp
+    import os
+
+    from gelly_tpu.utils import native as nat
+
+    n = min(cap_edges, src.shape[0])
+    n -= n % chunk
+    n_chunks = n // chunk
+    if n_chunks == 0 or not nat.sparse_codecs_available():
+        return {}
+    _CW.update(
+        src=np.ascontiguousarray(src[:n], np.int32),
+        dst=np.ascontiguousarray(dst[:n], np.int32),
+        n_v=n_v,
+    )
+    rates: dict = {}
+    modes: dict = {}
+    try:
+        ctx = mp.get_context("fork")
+    except ValueError:
+        ctx = None
+    for k in ks:
+        k_eff = min(k, n_chunks)
+        dt = None
+        if ctx is not None:
+            procs = []
+            try:
+                q = ctx.Queue(maxsize=2 * k_eff)
+                procs = [
+                    ctx.Process(
+                        target=_codec_worker_main,
+                        args=(w, k_eff, n_chunks, chunk, q),
+                        daemon=True,
+                    )
+                    for w in range(k_eff)
+                ]
+                t0 = time.perf_counter()
+                for p in procs:
+                    p.start()
+                done = 0
+                while done < k_eff:
+                    item = q.get(timeout=600)
+                    if item is None:
+                        done += 1
+                dt = time.perf_counter() - t0
+                for p in procs:
+                    p.join(timeout=60)
+            except Exception:  # noqa: BLE001 — wedged pool, fall through
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                dt = None
+        modes[str(k)] = "subprocess"
+        if dt is None:
+            # Thread fallback: whole-chunk ownership per worker, native
+            # combiner releases the GIL. Per-K label: a wedged pool on
+            # one K must not relabel the other K-points' regime.
+            from concurrent.futures import ThreadPoolExecutor
+
+            modes[str(k)] = "threads"
+
+            def one(ci):
+                lo = ci * chunk
+                return nat.cc_chunk_combine_sparse(
+                    _CW["src"][lo:lo + chunk], _CW["dst"][lo:lo + chunk],
+                    None, n_v,
+                )
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(k_eff) as ex:
+                for _ in ex.map(one, range(n_chunks)):
+                    pass
+            dt = time.perf_counter() - t0
+        rates[str(k)] = round(n / dt, 1)
+    _CW.clear()
+    return {
+        "codec_workers_eps": rates,
+        "codec_workers_mode": (
+            modes[next(iter(modes))] if len(set(modes.values())) == 1
+            else modes
+        ),
+        "codec_workers_chunk": chunk,
+        "codec_workers_edges": n,
+        "host_cores": os.cpu_count() or 1,
+    }
 
 
 def segment_compress_block(src, dst, n_v: int, chunk: int, batch: int,
@@ -626,7 +905,13 @@ def segment_compress_block(src, dst, n_v: int, chunk: int, batch: int,
         return {}
     n = src.shape[0]
     unit = chunk * batch
+    if n < unit:
+        # Reduced-size captures: shrink the unit to the stream rather
+        # than measuring zero edges (and dividing by them).
+        unit = max(chunk, n - n % chunk)
     n -= n % unit
+    if n == 0:
+        return {}
     # Bare combine: the native two-level forest alone.
     t0 = time.perf_counter()
     for lo in range(0, n, unit):
@@ -841,11 +1126,20 @@ def bench_triangles(args):
     from gelly_tpu.core.stream import edge_stream_from_source
     from gelly_tpu.core.vertices import IdentityVertexTable
 
+    from gelly_tpu.ops.pallas_kernels import on_tpu as _tri_on_tpu
+
     # 2M edges / 10 windows: large enough that the tunnel's fixed
     # per-run costs (~0.1-0.2 s of dispatch+pull latency) stop dominating
     # the measured rate, small enough for the per-window python oracle.
     n_e = min(args.edges, 2_000_000)
     n_v = min(args.vertices, 1 << 12)
+    if not _tri_on_tpu():
+        # Off-TPU every MXU tier runs through the Pallas interpreter
+        # (serial Python grid steps): shrink to structural sizes so the
+        # CPU artifact still carries the full line (figures marked by
+        # the capture's chip field, never quoted as perf).
+        n_e = min(n_e, 200_000)
+        n_v = min(n_v, 1 << 9)
     src, dst = synth_edges(n_e, n_v)
     ts = np.arange(n_e, dtype=np.int64)  # 10 windows
     window_ms = n_e // 10
@@ -975,7 +1269,7 @@ def bench_triangles(args):
     # Fixed scale, decoupled from the dense workload's clamped edge count:
     # per-dispatch tunnel RTT (~0.15s) needs ~10M edges to amortize, and
     # the python oracle's one timed pass stays ~10s.
-    n_sp = 10_000_000
+    n_sp = 10_000_000 if _tri_on_tpu() else 500_000
     src_sp = (rng.zipf(1.6, n_sp) % n_v_sp).astype(np.int64)
     dst_sp = (rng.zipf(1.6, n_sp) % n_v_sp).astype(np.int64)
     ts_sp = np.arange(n_sp, dtype=np.int64)
@@ -1543,11 +1837,72 @@ def bench_cc_large(args) -> dict:
     # 2^26-edge prefix at 2^25-edge chunks: dedup amortizes with chunk
     # size (distinct pairs grow sublinearly), so the mega-chunk shape is
     # the kernel's own operating point, not a bench trick. Exact label
-    # parity against the chunked numpy oracle rides along.
+    # parity against the chunked numpy oracle rides along — and the fold
+    # runs as a BACKEND SWEEP (the fold_backend= plan knob): XLA random
+    # gathers vs the Pallas VMEM-blocked chase kernel, each parity-
+    # checked, with the winner recorded as device_fold_eps. The
+    # gather_study block alongside decomposes the wall primitive by
+    # primitive (random vs sorted vs blocked-kernel touch rates, sort
+    # and scatter-min currency), so whichever way the sweep lands the
+    # artifact says WHY.
+    from gelly_tpu.ops.pallas_kernels import on_tpu as _bench_on_tpu
+
+    dev_chunk = min(1 << 25, n_e)
+    dev_max = min(1 << 26, n_e)
     fold_parity: dict = {}
-    dev_eps = device_bound_cc_eps(src, dst, n_v, 1 << 25,
-                                  max_edges=1 << 26,
+    dev_eps = device_bound_cc_eps(src, dst, n_v, dev_chunk,
+                                  max_edges=dev_max,
                                   parity_out=fold_parity)
+    fold_oracle = fold_parity.pop("device_fold_oracle", None)
+    sweep: dict = {
+        "device_fold_eps_xla": round(dev_eps, 1),
+        "device_fold_parity_xla": fold_parity.get("device_fold_parity"),
+    }
+    # Off-TPU the kernel interprets (serial Python grid): measure a
+    # reduced shape so the CPU artifact still exercises the path, but
+    # never let a reduced run win the headline comparison.
+    pal_chunk = dev_chunk if _bench_on_tpu() else min(dev_chunk, 1 << 22)
+    pal_max = dev_max if _bench_on_tpu() else pal_chunk
+    same_shape = (pal_chunk, pal_max) == (dev_chunk, dev_max)
+    dev_eps_pallas = None
+    pal_parity: dict = {}
+    try:
+        dev_eps_pallas = device_bound_cc_eps(
+            src, dst, n_v, pal_chunk, max_edges=pal_max,
+            parity_out=pal_parity, fold_backend="pallas",
+            oracle=fold_oracle if same_shape else None,
+        )
+        pal_parity.pop("device_fold_oracle", None)
+        sweep["device_fold_eps_pallas"] = round(dev_eps_pallas, 1)
+        sweep["device_fold_parity_pallas"] = pal_parity.get(
+            "device_fold_parity")
+        sweep["device_fold_no_transform_eps_pallas"] = pal_parity.get(
+            "device_fold_no_transform_eps")
+        notes = []
+        if not same_shape:
+            notes.append(f"cpu-interpret, reduced to chunk={pal_chunk}")
+        if not pal_parity.get("device_fold_dedup_engaged"):
+            notes.append(
+                "chunk below dedup threshold: the pallas kernel never "
+                "ran in this leg (parity is of the generic fold)"
+            )
+        if notes:
+            sweep["device_fold_pallas_note"] = "; ".join(notes)
+    except Exception as e:  # noqa: BLE001 — sweep must never kill the line
+        sweep["device_fold_pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+    if (dev_eps_pallas is not None and same_shape
+            and dev_eps_pallas > dev_eps
+            and pal_parity.get("device_fold_dedup_engaged")
+            and pal_parity.get("device_fold_parity") == "pass"):
+        dev_eps = dev_eps_pallas
+        sweep["device_fold_backend"] = "pallas"
+        fold_parity["device_fold_parity"] = pal_parity["device_fold_parity"]
+        fold_parity["device_fold_no_transform_eps"] = pal_parity.get(
+            "device_fold_no_transform_eps",
+            fold_parity.get("device_fold_no_transform_eps"))
+    else:
+        sweep["device_fold_backend"] = "xla"
+    sweep["gather_study"] = gather_study_block()
     # batch matches the pipeline's fold_batch so the stacked rows mirror
     # its per-dispatch combined payloads; the full stream is staged so the
     # once-per-window transform amortizes exactly as in the pipeline.
@@ -1591,11 +1946,17 @@ def bench_cc_large(args) -> dict:
         **segment_compress_block(src, dst, n_v, chunk, fold_batch,
                                  compact_m),
         **codec_scaling_block(src, dst, n_v, chunk),
+        **codec_workers_block(
+            src, dst, n_v, chunk, cap_edges=min(1 << 24, n_e),
+            ks=tuple(int(k) for k in getattr(
+                args, "codec_workers", "1,2,4").split(",")),
+        ),
         **mc,
         "vs_baseline_multicore": round(eps / mc["baseline_multicore_eps"], 2),
         "vs_baseline_model32": round(eps / mc["baseline_model32_eps"], 3),
         "device_fold_eps": round(dev_eps, 1),
         **fold_parity,
+        **sweep,
         "device_fold_payload_eps": round(dev_payload_eps, 1),
         "device_vs_model32": round(dev_eps / mc["baseline_model32_eps"], 2),
         # Roofline view of the star fold (logical-bytes model, see
@@ -1633,19 +1994,29 @@ for n_v in (1 << 20, 1 << 23, 1 << 24):
     # is no separate per-window cross-shard merge — folds keep the global
     # forest consistent through the keyed exchange).
     cc = ShardedCC(n_v, mesh=m)
-    cc.fold(a, b)  # compile
+    cc.fold(a, b)  # compile the fold path
+    # Warm the dirty-delta emission path too: the first labels() call
+    # pays one-time costs (sharded device_put transfer programs, D2H
+    # plumbing) that are not the stage's steady-state — round 5 recorded
+    # that cold call as the emission figure.
+    cc.labels()
     dt_s = float("inf")
-    for _ in range(2):
+    emits = []
+    for _ in range(3):
         cc2 = ShardedCC(n_v, mesh=m)
         t0 = time.perf_counter()
         cc2.fold(a, b)
         dt_s = min(dt_s, time.perf_counter() - t0)
-    # Incremental emission (VERDICT r4 item 3): resolves only the fold's
-    # dirty parent entries against the host root cache + ONE capacity
-    # gather (the output array itself).
-    t0 = time.perf_counter()
-    cc2.labels()
-    dt_emit = time.perf_counter() - t0
+        # Incremental emission (VERDICT r4 item 3): resolves only the
+        # fold's dirty parent entries against the host root cache + ONE
+        # capacity gather (the output array itself). Median-of-3, same
+        # repeat protocol as the CPU baseline; each repeat folds into a
+        # fresh instance so the dirty delta is identical every time.
+        t0 = time.perf_counter()
+        cc2.labels()
+        emits.append(time.perf_counter() - t0)
+    emits.sort()
+    dt_emit = emits[len(emits) // 2]
     # Replicated plan's per-window merge: stacked S x n_v forest union
     # (cost inherently prop. to full capacity, pairs or not).
     stack = jnp.broadcast_to(jnp.arange(n_v, dtype=jnp.int32)[None], (S, n_v))
@@ -1658,6 +2029,9 @@ for n_v in (1 << 20, 1 << 23, 1 << 24):
     out[str(n_v)] = {
         "sharded_fold_s": round(dt_s, 3),
         "emission_s": round(dt_emit, 3),
+        "emission_s_min": round(emits[0], 3),
+        "emission_s_max": round(emits[-1], 3),
+        "emission_repeats": len(emits),
         "replicated_merge_s": round(dt_r, 3),
         "per_device_state_bytes": cc.per_device_state_bytes(),
         "replicated_state_bytes": n_v * 5,
@@ -1731,7 +2105,12 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--workload", default="all",
                    choices=["all", "cc", "cc_large", "degrees", "triangles",
-                            "bipartiteness", "matching", "spanner"])
+                            "bipartiteness", "matching", "spanner", "codec",
+                            "gather"])
+    # K-points for the subprocess codec-scaling sweep (codec_workers_eps):
+    # comma list; oversubscribed K on small hosts is fine (the points then
+    # bound, rather than exhibit, scaling).
+    p.add_argument("--codec-workers", default="1,2,4")
     p.add_argument("--edges", type=int, default=64_000_000)
     p.add_argument("--vertices", type=int, default=1 << 17)
     p.add_argument("--chunk-size", type=int, default=1 << 23)
@@ -1761,14 +2140,32 @@ def main() -> int:
     small.chunk_size = min(args.chunk_size, 1 << 18)
     small.merge_every = 8
 
+    if args.workload == "gather":
+        emit({"metric": "gather_study", **gather_study_block()})
+        write_bench_artifact(args.workload)
+        return 0
+    if args.workload == "codec":
+        src, dst = synth_edges(min(args.edges, 1 << 24), args.vertices)
+        emit({
+            "metric": "codec_workers",
+            **codec_workers_block(
+                src, dst, args.vertices, min(args.chunk_size, 1 << 20),
+                ks=tuple(int(k) for k in args.codec_workers.split(",")),
+            ),
+        })
+        write_bench_artifact(args.workload)
+        return 0
     if args.workload == "spanner":
-        print(json.dumps(bench_spanner(args)))
+        emit(bench_spanner(args))
+        write_bench_artifact(args.workload)
         return 0
     if args.workload == "cc":
-        print(json.dumps(bench_cc(args)))
+        emit(bench_cc(args))
+        write_bench_artifact(args.workload)
         return 0
     if args.workload == "cc_large":
-        print(json.dumps(bench_cc_large(args)))
+        emit(bench_cc_large(args))
+        write_bench_artifact(args.workload)
         return 0
     # bipartiteness and degrees run codec-scale streams and self-clamp
     # their python baselines; the rest keep per-edge python baselines and
@@ -1780,36 +2177,53 @@ def main() -> int:
             args if args.workload in full_size else small
         )
         metric, eps, base_eps = out[:3]
-        print(json.dumps({
+        emit({
             "metric": metric,
             "value": round(eps, 1),
             "unit": "edges/sec",
             "vs_baseline": round(eps / base_eps, 2),
             **(out[3] if len(out) > 3 else {}),
-        }))
+        })
+        write_bench_artifact(args.workload)
         return 0
 
     # Default: all five BASELINE workloads plus the Twitter-scale CC
     # config, one JSON line each; the north-star-scale CC line prints
-    # LAST so a last-line parser records it.
-    for name, fn in others.items():
-        try:
-            out = fn(args if name in full_size else small)
-            metric, eps, base_eps = out[:3]
-            print(json.dumps({
-                "metric": metric,
-                "value": round(eps, 1),
-                "unit": "edges/sec",
-                "vs_baseline": round(eps / base_eps, 2),
-                **(out[3] if len(out) > 3 else {}),
-            }))
-        except SystemExit as e:
-            print(json.dumps({"metric": name, "error": str(e)}))
-    print(json.dumps(bench_spanner(args)))
-    print(json.dumps(bench_cc(args)))
-    print(json.dumps(bench_sharded_state()))
-    print(json.dumps(bench_cc_large(args)))
-    return 0
+    # LAST so a last-line parser records it. The full line set also
+    # lands in bench_out.json (write_bench_artifact).
+    # rc stays 0 even when individual workloads record error lines — the
+    # driver's capture treats a nonzero exit as a failed bench, and the
+    # per-line errors already carry the diagnosis.
+    rc = 0
+    try:
+        for name, fn in others.items():
+            try:
+                out = fn(args if name in full_size else small)
+                metric, eps, base_eps = out[:3]
+                emit({
+                    "metric": metric,
+                    "value": round(eps, 1),
+                    "unit": "edges/sec",
+                    "vs_baseline": round(eps / base_eps, 2),
+                    **(out[3] if len(out) > 3 else {}),
+                })
+            except (SystemExit, Exception) as e:  # noqa: BLE001
+                # A parity SystemExit or a workload crash still records a
+                # line: the artifact must carry every workload either way.
+                emit({"metric": name, "error": f"{type(e).__name__}: {e}"})
+        for name, heavy in (
+            ("spanner_device", lambda: bench_spanner(args)),
+            ("streaming_cc_throughput", lambda: bench_cc(args)),
+            ("sharded_state_cc", bench_sharded_state),
+            ("streaming_cc_large", lambda: bench_cc_large(args)),
+        ):
+            try:
+                emit(heavy())
+            except (SystemExit, Exception) as e:  # noqa: BLE001
+                emit({"metric": name, "error": f"{type(e).__name__}: {e}"})
+    finally:
+        write_bench_artifact(args.workload)
+    return rc
 
 
 if __name__ == "__main__":

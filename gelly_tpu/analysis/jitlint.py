@@ -28,6 +28,18 @@ become traced. Attribute reads that are static at trace time
 (``.shape``/``.ndim``/``.dtype``/``.size``), ``len()``, ``isinstance``,
 and ``is None`` tests are understood as concrete and never flagged.
 
+Pallas kernels are linted too: a ``pl.pallas_call(kernel, ...)`` site
+(any alias of ``jax.experimental.pallas``; ``functools.partial(kernel,
+...)`` wrappers included) descends into the kernel function with every
+ref parameter treated as traced — the same GLxxx rules apply inside
+(ref reads are traced values; ``np.*`` on them would force a host sync
+at lowering). Pallas grid/meta helpers (``pl.ds``, ``pl.cdiv``,
+``pl.multiple_of``, ``pl.num_programs``, ``pl.BlockSpec``,
+``pltpu.*`` constructors, ...) are understood as concrete so kernel
+plumbing does not produce false GLxxx positives; ``pl.program_id`` and
+``pl.load`` stay traced (control flow on a grid index is a real
+trace-time hazard — use ``pl.when``).
+
 Suppress a finding by appending ``# graphlint: disable=GL00x`` (comma
 list or ``all``) to the flagged line.
 """
@@ -87,6 +99,11 @@ _SYNC_METHODS = {"item", "tolist"}
 
 _SUPPRESS_RE = re.compile(r"#\s*graphlint:\s*disable=([A-Za-z0-9,\s]+)")
 
+# Pallas-alias calls that yield TRACED values (everything else reached
+# through a pallas alias — pl.ds, pl.cdiv, pl.BlockSpec, pltpu.VMEM,
+# grid-spec constructors — is meta/concrete plumbing).
+_PALLAS_TRACED_CALLS = {"pallas_call", "load", "program_id"}
+
 
 def _attr_chain(node: ast.AST):
     """('jax','numpy','stack') for jax.numpy.stack; None if not a plain
@@ -110,6 +127,9 @@ class _Module:
     numpy_aliases: set
     jnp_aliases: set                 # names bound to jax.numpy
     jax_aliases: set                 # names bound to jax itself
+    pallas_aliases: set              # names bound to jax.experimental.pallas
+    #   (or .tpu) — pl / pltpu under any local alias
+    pallas_call_names: set           # names bound to pallas_call itself
     jit_names: set                   # names bound to jax.jit via from-import
     module_aliases: dict             # local name -> module path on disk
     from_functions: dict             # local name -> (module path, def name)
@@ -157,8 +177,9 @@ class JitLinter:
         m = _Module(
             path=path, dotted=self._dotted_name(path), tree=tree,
             lines=src.splitlines(), numpy_aliases=set(), jnp_aliases=set(),
-            jax_aliases=set(), jit_names=set(), module_aliases={},
-            from_functions={}, functions={}, all_functions=[], jit_called={},
+            jax_aliases=set(), pallas_aliases=set(), pallas_call_names=set(),
+            jit_names=set(), module_aliases={}, from_functions={},
+            functions={}, all_functions=[], jit_called={},
         )
         self._collect_imports(m)
         for node in tree.body:
@@ -181,6 +202,14 @@ class JitLinter:
                         m.numpy_aliases.add(local)
                     elif alias.name == "jax.numpy":
                         m.jnp_aliases.add(alias.asname or "jax")
+                    elif alias.name.startswith("jax.experimental.pallas"):
+                        # Only an EXPLICIT asname is a pallas alias: the
+                        # plain form binds the name "jax", and marking
+                        # "jax" as pallas would make _concrete_refs
+                        # treat every jax.* call as meta plumbing —
+                        # silently suppressing real findings module-wide.
+                        if alias.asname:
+                            m.pallas_aliases.add(alias.asname)
                     elif alias.name == "jax":
                         m.jax_aliases.add(local)
                     elif alias.name.split(".")[0] == "gelly_tpu":
@@ -201,6 +230,18 @@ class JitLinter:
             return
         if node.level == 0 and node.module == "jax.numpy":
             return  # from jax.numpy import x — per-symbol, not linted
+        if node.level == 0 and node.module == "jax.experimental":
+            for alias in node.names:
+                if alias.name == "pallas":
+                    m.pallas_aliases.add(alias.asname or "pallas")
+            return
+        if node.level == 0 and node.module == "jax.experimental.pallas":
+            for alias in node.names:
+                if alias.name == "tpu":
+                    m.pallas_aliases.add(alias.asname or "tpu")
+                elif alias.name == "pallas_call":
+                    m.pallas_call_names.add(alias.asname or "pallas_call")
+            return
         # Resolve the source module (absolute gelly_tpu.* or relative).
         if node.level == 0:
             if not (node.module or "").startswith("gelly_tpu"):
@@ -304,6 +345,12 @@ class JitLinter:
                 traced = self._traced_params(fn, statics, nums)
                 self._lint_function(m, fn, traced,
                                     via=f"jitted {fn.name!r}", expand=True)
+        # Every pallas_call site in the module descends into its kernel,
+        # jitted context or not — kernels always compile (Mosaic), so the
+        # same hazards apply. (_visited dedups kernels reached both ways.)
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) and self._is_pallas_call(m, node):
+                self.expand_pallas_kernel(m, node, via="pallas_call")
 
     @staticmethod
     def _traced_params(fn: ast.FunctionDef, statics, nums) -> set:
@@ -341,6 +388,53 @@ class JitLinter:
         _FunctionLint(self, m, traced, via, expand).run(fn)
 
     # ------------------------------------------------- callee expansion
+
+    def _is_pallas_call(self, m: _Module, call: ast.Call) -> bool:
+        if (isinstance(call.func, ast.Name)
+                and call.func.id in m.pallas_call_names):
+            return True  # from jax.experimental.pallas import pallas_call
+        chain = _attr_chain(call.func)
+        if chain is None or chain[-1] != "pallas_call":
+            return False
+        # pl.pallas_call under any alias, or the fully-dotted
+        # jax.experimental.pallas.pallas_call spelling (whose root "jax"
+        # is deliberately NOT a pallas alias — see _collect_imports).
+        return (chain[0] in m.pallas_aliases
+                or chain[:3] == ("jax", "experimental", "pallas"))
+
+    @staticmethod
+    def _kernel_name_node(node: ast.AST):
+        """The kernel-function Name of a pallas_call first argument —
+        unwrapping ``functools.partial(kernel, ...)`` under any partial
+        spelling."""
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] == "partial" and node.args:
+                return JitLinter._kernel_name_node(node.args[0])
+            return None
+        return node if isinstance(node, ast.Name) else None
+
+    def expand_pallas_kernel(self, m: _Module, call: ast.Call,
+                             via: str) -> None:
+        """Lint the kernel function of a ``pl.pallas_call(kernel, ...)``
+        site with every parameter traced (refs ARE traced values; the
+        ints a partial() binds are harmless to overapproximate)."""
+        if not call.args:
+            return
+        name = self._kernel_name_node(call.args[0])
+        if name is None:
+            return
+        target = self._resolve_callee(m, name)
+        if target is None:
+            return
+        kernel_module, kernel = target
+        params = [a.arg for a in (kernel.args.posonlyargs + kernel.args.args
+                                  + kernel.args.kwonlyargs)]
+        traced = {p for p in params if p not in ("self", "cls")}
+        self._lint_function(
+            kernel_module, kernel, traced,
+            via=f"{via} -> pallas kernel {kernel.name!r}", expand=False,
+        )
 
     def expand_call(self, m: _Module, call: ast.Call, traced_args: list,
                     via: str) -> None:
@@ -575,6 +669,18 @@ class _FunctionLint:
             if (isinstance(node.func, ast.Name)
                     and node.func.id in _STATIC_CALLS):
                 return set()
+            chain = _attr_chain(node.func)
+            if chain is not None and chain[0] in self.m.pallas_aliases:
+                if chain[-1] not in _PALLAS_TRACED_CALLS:
+                    # pl.ds / pl.cdiv / pl.BlockSpec / pltpu.VMEM ... —
+                    # grid and meta plumbing, concrete at trace time.
+                    return set()
+                # program_id / load / pallas_call yield traced values
+                # even with no traced-name operands: surface a pseudo-ref
+                # so `if pl.program_id(0) == 0:` still flags GL002 (the
+                # fix is pl.when) and assignments from them mark their
+                # targets traced.
+                return {f"{chain[0]}.{chain[-1]}(...)"}
         out: set = set()
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.expr):

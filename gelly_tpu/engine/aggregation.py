@@ -122,6 +122,13 @@ class SummaryAggregation:
     # restored device summary.
     on_run_start: Callable[[], None] | None = None
     on_resume: Callable[[Summary], None] | None = None
+    # Device-fold kernel backend the plan's fold closures were built for
+    # ("xla" | "pallas"): set by the library plan builders (e.g.
+    # connected_components(fold_backend=...)), recorded here so the
+    # engine's compiled-plan cache keys on it — the same aggregation
+    # instance re-jits (rather than silently reusing stale executables)
+    # if a caller rebuilds its folds for a different backend.
+    fold_backend: str = "xla"
     # True for plans whose fold exists ONLY through the ingest codec (the
     # compact-space plans: raw chunks carry ids the summary's compact space
     # has no mapping for). The engine then refuses — loudly, at plan time —
@@ -311,7 +318,8 @@ def _compiled_plan(agg: SummaryAggregation, m):
     # closures on every run_aggregation call would recompile the whole plan
     # each time (~10s/program over the TPU tunnel). Storing on the instance
     # ties the cache (and its compiled executables) to the agg's lifetime.
-    key = (tuple(d.id for d in m.devices.flat), m.axis_names)
+    key = (tuple(d.id for d in m.devices.flat), m.axis_names,
+           agg.fold_backend)
     per_agg = agg.__dict__.setdefault("_plan_cache", {})
     if key in per_agg:
         return per_agg[key]

@@ -32,6 +32,7 @@ from ..engine.aggregation import (  # noqa: F401  (threshold re-exported)
     SummaryAggregation,
 )
 from ..ops import segments, unionfind
+from ..ops.pallas_kernels import on_tpu as pallas_on_tpu
 
 
 class CCSummary(NamedTuple):
@@ -455,9 +456,38 @@ def connected_components_compact(
     return agg
 
 
+def resolve_fold_backend(fold_backend: str, vertex_capacity: int) -> str:
+    """Shared ``fold_backend=`` knob semantics: validate and resolve
+    ``"auto"``/``"xla"``/``"pallas"`` for the raw device fold.
+
+    ``"auto"`` resolves to ``"xla"``: the Pallas path's profitability is
+    hardware-dependent (it trades MXU flops for HBM random-touch latency;
+    see the bench's ``gather_study`` block), so the measured sweep — not
+    a heuristic — should flip the default. ``"pallas"`` validates the
+    capacity against the kernel's window-blocking requirements up front,
+    at plan-build time, instead of failing mid-stream.
+    """
+    if fold_backend not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"fold_backend must be auto/xla/pallas, got {fold_backend!r}"
+        )
+    if fold_backend == "pallas":
+        from ..ops.pallas_kernels import gatherable
+
+        if not gatherable(vertex_capacity):
+            raise ValueError(
+                f"fold_backend='pallas' needs a window-blockable vertex "
+                f"capacity (multiple of 128 lanes spanning >= 2 windows, "
+                f"<= 2^24); got {vertex_capacity}"
+            )
+        return "pallas"
+    return "xla"
+
+
 def connected_components(
     vertex_capacity: int, merge: str = "tree", ingest_combine: bool = True,
     codec: str = "auto", compact_capacity: int | None = None,
+    fold_backend: str = "auto",
 ) -> SummaryAggregation:
     """Build the CC aggregation over a slot space of ``vertex_capacity``.
 
@@ -490,6 +520,17 @@ def connected_components(
       plan; requires the ingest codec (no raw-chunk/window_ms fold).
     - ``"auto"`` (default) — sparse iff ``vertex_capacity >=``
       :data:`SPARSE_CODEC_MIN_CAPACITY` (2^20).
+
+    ``fold_backend`` picks the RAW device fold's kernel backend
+    (:func:`resolve_fold_backend`): ``"pallas"`` routes the large-chunk
+    sort-dedup fold's sorted chases through the VMEM-blocked gather
+    kernel (:func:`~gelly_tpu.ops.pallas_kernels.sorted_window_gather`,
+    exact — window misses fall through to the exact tail fixpoint);
+    ``"auto"`` stays on XLA until the recorded bench sweep says
+    otherwise. The codec plans' device folds are pair/star folds that
+    never run the raw dedup kernel, so the knob only shapes the
+    codec-off fold path (window mode, ``ingest_combine=False``, and the
+    device-bound bench).
     """
     from ..engine.aggregation import resolve_sparse_codec
 
@@ -501,6 +542,10 @@ def connected_components(
         )
     n = vertex_capacity
     sparse = resolve_sparse_codec(codec, n)
+    backend = resolve_fold_backend(fold_backend, n)
+    # Static per-plan choice: jit specializes the fold on it, and the
+    # engine's compiled-plan cache keys on agg.fold_backend.
+    interp = None if backend == "xla" else not pallas_on_tpu()
 
     def init() -> CCSummary:
         return CCSummary(
@@ -522,6 +567,7 @@ def connected_components(
                 # with this cap, and overflow only costs speed (exact
                 # full-width fallback), never correctness.
                 unique_cap=max(1 << 20, 3 * (chunk.capacity >> 4)),
+                backend=backend, interpret=interp,
             )
         else:
             parent = unionfind.union_edges(
@@ -652,6 +698,7 @@ def connected_components(
             stack_sparse if (ingest_combine and sparse) else None
         ),
         fold_accumulates=True,  # CC forests are pure edge-set summaries
+        fold_backend=backend,
         name=f"connected-components-{merge}",
     )
 

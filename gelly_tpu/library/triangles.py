@@ -66,7 +66,12 @@ def _wedge_count_from_adj(adj: jax.Array, key: jax.Array, nbr: jax.Array,
     if method.startswith("mxu"):
         from ..ops.pallas_kernels import wedge_count_matrix
 
-        w = wedge_count_matrix(m, interpret=method == "mxu_interpret")
+        w = wedge_count_matrix(
+            m,
+            # explicit interpret only when forced; None = auto
+            # (compiled on TPU, interpreter on the CPU mesh)
+            interpret=True if method == "mxu_interpret" else None,
+        )
         per_edge = w[key, nbr].astype(jnp.int32)
     else:
         # per-edge common smaller-neighbor count: dot of M columns a and b
@@ -172,7 +177,12 @@ def _window_triangle_count_packed(packed: jax.Array, n: int, capacity: int,
     if method.startswith("mxu"):
         from ..ops.pallas_kernels import wedge_count_matrix
 
-        w = wedge_count_matrix(m, interpret=method == "mxu_interpret")
+        w = wedge_count_matrix(
+            m,
+            # explicit interpret only when forced; None = auto
+            # (compiled on TPU, interpreter on the CPU mesh)
+            interpret=True if method == "mxu_interpret" else None,
+        )
         per_edge = w[a, b].astype(jnp.int32)
     else:
         per_edge = jnp.sum(m[:, a] & m[:, b], axis=0)

@@ -318,6 +318,130 @@ def test_jitlint_suppression_is_line_scoped(tmp_path):
     assert "np.exp" in findings[0].message
 
 
+def test_jitlint_descends_into_pallas_kernels(tmp_path):
+    # pl.pallas_call(kernel, ...) sites descend into the kernel with ref
+    # params traced — hazards inside kernels surface, including through
+    # a functools.partial(kernel, ...) wrapper, and including kernels
+    # only reachable from non-jitted builder functions.
+    p = tmp_path / "kern.py"
+    p.write_text(textwrap.dedent("""\
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+        from jax.experimental import pallas as pl
+
+        def _bad_kernel(a_ref, o_ref):
+            x = a_ref[:]
+            if pl.program_id(0) == 0:
+                o_ref[:] = x
+            o_ref[:] = jnp.asarray(np.sum(x))
+
+        def _partial_kernel(n, a_ref, o_ref):
+            o_ref[:] = a_ref[:] + np.int32(n)
+
+        def build(x):
+            g = pl.pallas_call(
+                _bad_kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))
+            h = pl.pallas_call(
+                partial(_partial_kernel, 3),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))
+            return g(x), h(x)
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert any("np.sum" in m for m in by_rule.get("GL001", []))
+    assert any("_partial_kernel" in m and "np.int32" in m
+               for m in by_rule.get("GL001", []))
+    assert any("pl.program_id" in m for m in by_rule.get("GL002", []))
+    for f in findings:
+        assert "pallas kernel" in f.message, f.render()
+
+
+def test_jitlint_no_false_positives_on_pallas_plumbing(tmp_path):
+    # Grid/meta plumbing (pl.ds, pl.cdiv, pl.BlockSpec, pltpu.* scratch
+    # constructors, pl.when decorators, partial-bound ints) must not
+    # produce GLxxx findings — the regression the repo's own kernels
+    # gate on (see also test_jitlint_clean_on_repo_tip, which now
+    # descends into gelly_tpu's real kernels).
+    p = tmp_path / "clean.py"
+    p.write_text(textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _clean_kernel(s_ref, a_ref, o_ref):
+            g = pl.program_id(0)
+            base = s_ref[g] * jnp.int32(8)
+            row = jax.lax.div(a_ref[:], jnp.int32(128))
+            for t0 in range(0, 8, 4):
+                o_ref[t0:t0 + 4] = row[t0:t0 + 4] + base
+
+        def build(starts, x):
+            spec = pl.BlockSpec((8, 128), lambda g, s: (g, 0))
+            return pl.pallas_call(
+                _clean_kernel,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1, grid=(4,),
+                    in_specs=[spec], out_specs=spec),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(starts, x)
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_jitlint_plain_pallas_import_does_not_blind_jax_calls(tmp_path):
+    # 'import jax.experimental.pallas' (no asname) binds the name 'jax';
+    # treating THAT as a pallas alias would mark every jax.* call as
+    # concrete plumbing and suppress real findings module-wide.
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent("""\
+        import jax
+        import jax.experimental.pallas
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.log(x)
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    assert [f.rule for f in findings] == ["GL001"]
+
+
+def test_jitlint_pallas_call_other_spellings_descend(tmp_path):
+    # Fully-dotted jax.experimental.pallas.pallas_call and the bare
+    # from-import both resolve their kernels.
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent("""\
+        import numpy as np
+        import jax
+        import jax.experimental.pallas
+        from jax.experimental.pallas import pallas_call
+
+        def _k1(a_ref, o_ref):
+            o_ref[:] = np.asarray(a_ref[:])
+
+        def _k2(a_ref, o_ref):
+            o_ref[:] = np.abs(a_ref[:])
+
+        def build(x):
+            shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
+            f = jax.experimental.pallas.pallas_call(_k1, out_shape=shape)
+            g = pallas_call(_k2, out_shape=shape)
+            return f(x), g(x)
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    kernels = {f.message.split("pallas kernel ")[1].split("]")[0]
+               for f in findings}
+    assert {"'_k1'", "'_k2'"} == kernels
+    assert all(f.rule == "GL001" for f in findings)
+
+
 def test_jitlint_cli_nonzero_on_fixture(lint_fixture):
     root, path = lint_fixture
     proc = subprocess.run(
