@@ -721,6 +721,43 @@ def device_bound_degrees_eps(src, dst, n_v: int, chunk_size: int,
                              chunk_size)
 
 
+def _overlap_block(stages: dict) -> dict:
+    """Overlap-aware stage accounting for the pipelined executor.
+
+    ``stages`` are thread-summed per-stage BUSY seconds plus
+    ``total_wall``. ``overlap_efficiency`` = wall / max(busy): 1.0 means
+    the wall collapsed onto the slowest stage (perfect overlap).
+    ``pipeline_serial_sum_s`` is the serial cost of the fold path's three
+    stages (compress + H2D + fold) — a healthy pipelined run lands
+    ``total_wall`` below it (``wall_lt_pipeline_serial_sum``), which is
+    exactly the win the executor exists for: on the r05 TPU capture those
+    three ran back-to-back for 71% of an 11.0s wall.
+
+    ``codec_wait`` (ordered-turn lock-wait the engine reclassified out of
+    ``ingest_compress``) is excluded from the busy/efficiency math: it is
+    serialization, not work — a genuinely serial run never waits there,
+    so counting it would overstate the serial side of the comparison.
+    It stays visible in the line's ``stages`` field.
+    """
+    from gelly_tpu.utils.metrics import overlap_stats
+
+    tw = stages.get("total_wall")
+    if not tw:
+        return {}
+    o = overlap_stats(stages, tw, exclude=("total_wall", "codec_wait"))
+    pipeline_sum = sum(
+        stages.get(k, 0.0)
+        for k in ("ingest_compress", "h2d", "fold_dispatch")
+    )
+    return {
+        "overlap_efficiency": o["overlap_efficiency"],
+        "stage_busy_max_s": o["stage_busy_max_s"],
+        "serial_stage_sum_s": o["serial_stage_sum_s"],
+        "pipeline_serial_sum_s": round(pipeline_sum, 4),
+        "wall_lt_pipeline_serial_sum": bool(tw < pipeline_sum),
+    }
+
+
 def codec_scaling_block(src, dst, n_v: int, chunk: int,
                         cap_edges: int = 1 << 24) -> dict:
     """Host-codec scaling row (VERDICT r3 item 3): edges/s of the
@@ -803,7 +840,17 @@ def codec_workers_block(src, dst, n_v: int, chunk: int,
     n -= n % chunk
     n_chunks = n // chunk
     if n_chunks == 0 or not nat.sparse_codecs_available():
-        return {}
+        # Self-describing skip (the r05 capture recorded only {"1": ...}
+        # with no explanation): an empty sweep must say WHY.
+        return {
+            "codec_workers_eps": {},
+            "codec_workers_requested": list(ks),
+            "codec_workers_skipped_reason": (
+                "stream shorter than one chunk" if n_chunks == 0
+                else "native sparse codec unavailable"
+            ),
+            "host_cores": os.cpu_count() or 1,
+        }
     _CW.update(
         src=np.ascontiguousarray(src[:n], np.int32),
         dst=np.ascontiguousarray(dst[:n], np.int32),
@@ -811,6 +858,8 @@ def codec_workers_block(src, dst, n_v: int, chunk: int,
     )
     rates: dict = {}
     modes: dict = {}
+    detail: dict = {}
+    host_cores = os.cpu_count() or 1
     try:
         ctx = mp.get_context("fork")
     except ValueError:
@@ -868,16 +917,42 @@ def codec_workers_block(src, dst, n_v: int, chunk: int,
                     pass
             dt = time.perf_counter() - t0
         rates[str(k)] = round(n / dt, 1)
+        # Requested-vs-effective per K: a reduced capture (few chunks,
+        # single-core host) silently reshapes the sweep — record the
+        # clamp and the timesharing regime so the artifact explains
+        # itself instead of looking like a truncated sweep. `note`
+        # carries regime caveats for points that RAN; `skipped_reason`
+        # is reserved for points with no measured rate (a consumer
+        # filtering on it must not drop real measurements).
+        notes = []
+        if k_eff < k:
+            notes.append(
+                f"clamped to {k_eff}: stream has only {n_chunks} chunks"
+            )
+        if k > host_cores:
+            notes.append(
+                f"oversubscribed: {k} workers timeshare {host_cores} "
+                "core(s) — the point bounds, not exhibits, scaling"
+            )
+        detail[str(k)] = {
+            "requested": k,
+            "effective": k_eff,
+            "mode": modes[str(k)],
+            "note": "; ".join(notes) or None,
+            "skipped_reason": None,
+        }
     _CW.clear()
     return {
         "codec_workers_eps": rates,
+        "codec_workers_requested": list(ks),
+        "codec_workers_detail": detail,
         "codec_workers_mode": (
             modes[next(iter(modes))] if len(set(modes.values())) == 1
             else modes
         ),
         "codec_workers_chunk": chunk,
         "codec_workers_edges": n,
-        "host_cores": os.cpu_count() or 1,
+        "host_cores": host_cores,
     }
 
 
@@ -1682,8 +1757,8 @@ def bench_cc(args) -> dict:
             }))
 
     stages = {
-        k: round(v["total_s"], 4)
-        for k, v in (timer.report() if timer else {}).items()
+        k: round(v, 4)
+        for k, v in (timer.busy() if timer else {}).items()
     }
     stages["total_wall"] = round(dt_tpu, 4)
     mc = multicore_baseline_block(src, dst, args.vertices, spec={
@@ -1755,9 +1830,11 @@ def bench_cc(args) -> dict:
         "windowed_raw_eps": round(win_rates["raw"], 1),
         "windowed_codec_speedup": round(
             win_rates["codec"] / win_rates["raw"], 2),
-        # Stage seconds are thread-summed (ingest stages may run on
-        # multiple workers), so they can exceed total_wall.
+        # Stage seconds are thread-summed BUSY time (ingest stages may
+        # run on multiple workers), so they can exceed total_wall; the
+        # overlap block relates them to the wall clock.
         "stages": stages,
+        **_overlap_block(stages),
     }
 
 
@@ -1772,15 +1849,23 @@ def bench_cc_large(args) -> dict:
     n_v = args.large_vertices
     n_e = args.large_edges
     chunk = args.large_chunk_size
-    # Big fold batches: per-dispatch fixed costs amortize, and the host
-    # group pre-combine dedups more pairs per payload row (touched
-    # vertices grow sublinearly in window edges on skewed streams), so
-    # fewer, larger merge windows win on both sides of the link. 64
-    # chunks/window = 4 emissions over the 2^28 stream.
-    merge_every = fold_batch = 64
+    # Big merge windows: fewer full-capacity transforms, and the host
+    # group pre-combine dedups more pairs per payload (touched vertices
+    # grow sublinearly in window edges on skewed streams). 64
+    # chunks/window = 4 emissions over the 2^28 stream. The STAGED unit
+    # is deliberately smaller than the window (fold_batch=16 → 4 units
+    # per window): a window-sized mega-unit serializes the whole window's
+    # compress behind ONE pool worker and leaves the pipelined executor
+    # nothing to overlap — unit granularity is what feeds it.
+    merge_every = 64
+    fold_batch = 16
     # Compact root space (codec="compact"): M bounds distinct touched
-    # vertices per run (~5.5M for this stream), NOT capacity or edges.
-    compact_m = 1 << 23
+    # vertices per run (~5.5M for the north-star stream), NOT capacity or
+    # edges — and never needs to exceed the vertex space, so a reduced
+    # capture's M tracks its reduced capacity (an oversized M only
+    # inflates the once-per-window transform, which at CPU-capture sizes
+    # buried the pipeline stages under merge_emit).
+    compact_m = min(1 << 23, n_v)
     src, dst = synth_edges(n_e, n_v, seed=17)
     hot_degree = int(
         (np.bincount(src, minlength=n_v) + np.bincount(dst, minlength=n_v))
@@ -1922,10 +2007,11 @@ def bench_cc_large(args) -> dict:
     )
 
     stages = {
-        k: round(v["total_s"], 4)
-        for k, v in (timer.report() if timer else {}).items()
+        k: round(v, 4)
+        for k, v in (timer.busy() if timer else {}).items()
     }
     stages["total_wall"] = round(dt_tpu, 4)
+    overlap = _overlap_block(stages)
     rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
     avail_gb = 0.0
     with open("/proc/meminfo") as f:
@@ -1969,23 +2055,34 @@ def bench_cc_large(args) -> dict:
         "peak_rss_gb": round(rss_gb, 2),
         "mem_available_gb": round(avail_gb, 2),
         "stages": stages,
+        **overlap,
     }
 
 
 _SHARDED_STATE_CHILD = r"""
 import json, time
+from functools import partial
 import numpy as np
 import jax
 jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp
-from gelly_tpu.parallel import mesh as mesh_lib
+from jax.sharding import NamedSharding, PartitionSpec as P
+from gelly_tpu.parallel import collectives, mesh as mesh_lib
+from gelly_tpu.parallel.mesh import SHARD_AXIS
 from gelly_tpu.parallel.sharded_cc import ShardedCC
-from gelly_tpu.ops.unionfind import merge_forest_stack
+from gelly_tpu.ops.unionfind import (
+    fresh_forest, merge_forest_stack, union_edges, union_pairs_rooted,
+)
 
 S = 8
 m = mesh_lib.make_mesh(S)
+sharded = NamedSharding(m, P(SHARD_AXIS))
 rng = np.random.default_rng(11)
 n_pairs = 1 << 16
+# Per-shard touched slots are bounded by 2 * (n_pairs / S): the delta
+# gather bucket that covers the worst case (the engine sizes it from the
+# measured count; here the bound is static).
+DELTA_BUCKET = 2 * (n_pairs // S)
 out = {}
 for n_v in (1 << 20, 1 << 23, 1 << 24):
     a = (rng.zipf(1.4, n_pairs) % n_v).astype(np.int32)
@@ -2026,6 +2123,57 @@ for n_v in (1 << 20, 1 << 23, 1 << 24):
         t0 = time.perf_counter()
         np.asarray(merge_forest_stack(stack))
         dt_r = min(dt_r, time.perf_counter() - t0)
+
+    # Dirty-delta merge (the engine's merge_mode="delta" window close):
+    # S per-shard window forests holding the SAME pairs exchange only
+    # their compacted dirty (slot, parent) rows and union them into the
+    # replicated base — cost prop. to touched rows, not capacity. Same
+    # repeat protocol as the replicated row; the CLAIM is the capacity
+    # slope of this row next to the replicated one.
+    av = jax.device_put(a.reshape(S, -1).astype(np.int32), sharded)
+    bv = jax.device_put(b.reshape(S, -1).astype(np.int32), sharded)
+
+    @partial(jax.jit, out_shardings=(sharded, sharded))
+    def build_locals(aa, bb):
+        def body(a_, b_):
+            ok = jnp.ones(a_.shape[-1], bool)
+            p = union_edges(fresh_forest(n_v), a_[0], b_[0], ok)
+            seen = jnp.zeros((n_v,), bool).at[a_[0]].set(True)
+            seen = seen.at[b_[0]].set(True)
+            return p[None], seen[None]
+        return mesh_lib.shard_map_fn(
+            m, body, in_specs=(P(SHARD_AXIS),) * 2,
+            out_specs=(P(SHARD_AXIS),) * 2,
+        )(aa, bb)
+
+    @jax.jit
+    def delta_merge(lp, ls, base):
+        def body(p, s, g):
+            iota = jnp.arange(n_v, dtype=jnp.int32)
+            d = s[0] | (p[0] != iota)
+            slots, vals, _ = collectives.compact_delta(d, p[0], DELTA_BUCKET)
+            gs, gv = collectives.gather_delta(slots, vals)
+            ok = gs >= 0
+            # union_pairs_rooted: every round sized to the gathered rows,
+            # no full-capacity flatten (the library merge_delta's kernel).
+            merged = union_pairs_rooted(
+                g, jnp.where(ok, gs, 0), jnp.where(ok, gv, 0), ok
+            )
+            return merged[None]
+        return mesh_lib.shard_map_fn(
+            m, body, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+            out_specs=P(SHARD_AXIS),
+        )(lp, ls, base)
+
+    lp, ls = build_locals(av, bv)
+    base = fresh_forest(n_v)
+    jax.block_until_ready(delta_merge(lp, ls, base))  # compile
+    dt_d = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        jax.block_until_ready(delta_merge(lp, ls, base))
+        dt_d = min(dt_d, time.perf_counter() - t0)
+
     out[str(n_v)] = {
         "sharded_fold_s": round(dt_s, 3),
         "emission_s": round(dt_emit, 3),
@@ -2033,6 +2181,8 @@ for n_v in (1 << 20, 1 << 23, 1 << 24):
         "emission_s_max": round(emits[-1], 3),
         "emission_repeats": len(emits),
         "replicated_merge_s": round(dt_r, 3),
+        "delta_merge_s": round(dt_d, 4),
+        "delta_bucket": DELTA_BUCKET,
         "per_device_state_bytes": cc.per_device_state_bytes(),
         "replicated_state_bytes": n_v * 5,
     }
@@ -2091,6 +2241,16 @@ def bench_sharded_state() -> dict:
         "unit": "x fold cost for 8x capacity (8-dev CPU mesh; 1.0 = flat)",
         "capacity_slope_replicated_merge": round(
             hi["replicated_merge_s"] / max(lo["replicated_merge_s"], 1e-9), 2,
+        ),
+        # The dirty-delta merge (merge_mode="delta") measured on the SAME
+        # pair windows: its slope vs capacity must sit strictly below the
+        # replicated row's (the r05 replicated slope hit 3.65 at 8x and
+        # 32.2s absolute at 2^24; delta cost tracks touched rows).
+        "capacity_slope_delta_merge": round(
+            hi["delta_merge_s"] / max(lo["delta_merge_s"], 1e-9), 2,
+        ),
+        "delta_merge_lt_replicated_at_2e24": bool(
+            star["delta_merge_s"] < star["replicated_merge_s"]
         ),
         # VERDICT r4 item 3's bar, at the 2^24 north-star capacity:
         # incremental emission at or below the fold cost.

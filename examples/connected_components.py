@@ -1,6 +1,7 @@
 """Streaming Connected Components (ConnectedComponentsExample.java:49-169).
 
 Usage: python examples/connected_components.py [--checkpoint-dir=DIR]
+           [--codec-workers=K] [--h2d-depth=D] [--merge-mode=MODE]
            [<edges path> <merge every chunks>]
 Prints (vertex, component) pairs after each merge window.
 
@@ -8,6 +9,15 @@ Prints (vertex, component) pairs after each merge window.
 (``gelly_tpu.engine.resilience``): the fold checkpoints into DIR every
 merge window, and re-running the same command after a crash resumes from
 the newest valid checkpoint instead of refolding from chunk zero.
+
+Pipelined-executor knobs (see the README "Pipelined executor" section):
+``--codec-workers=K`` sizes the host compress pool, ``--h2d-depth=D``
+bounds the in-flight device double buffers (0 = transfer inline), and
+``--merge-mode=delta|replicated|auto`` picks the cross-shard window
+merge (dirty-delta rows vs full summaries). They configure the
+aggregate path only — combining them with ``--checkpoint-dir`` (the
+resilient raw-fold driver, which has no codec/H2D pipeline or merge
+windows) is an error, not a silent no-op.
 """
 
 import sys
@@ -22,17 +32,41 @@ from gelly_tpu.library.connected_components import (
 
 def main(args):
     ckpt_dir = None
+    codec_workers = None
+    h2d_depth = None
+    merge_mode = "auto"
     rest = []
     for a in args:
         if a.startswith("--checkpoint-dir="):
             ckpt_dir = a.split("=", 1)[1]
+        elif a.startswith("--codec-workers="):
+            codec_workers = int(a.split("=", 1)[1])
+        elif a.startswith("--h2d-depth="):
+            h2d_depth = int(a.split("=", 1)[1])
+        elif a.startswith("--merge-mode="):
+            merge_mode = a.split("=", 1)[1]
         else:
             rest.append(a)
+    if ckpt_dir is not None and (
+        codec_workers is not None or h2d_depth is not None
+        or merge_mode != "auto"
+    ):
+        raise SystemExit(
+            "--codec-workers/--h2d-depth/--merge-mode configure the "
+            "pipelined executor (stream.aggregate); --checkpoint-dir runs "
+            "the resilient raw-fold driver, which has no codec/H2D "
+            "pipeline or merge windows — drop the executor knobs or the "
+            "checkpoint dir"
+        )
     stream = stream_from_args(rest, default_edges=sequence_default_edges())
     merge_every = arg(rest, 1, 4)
-    agg = connected_components(stream.ctx.vertex_capacity)
+    agg = connected_components(stream.ctx.vertex_capacity,
+                               merge_mode=merge_mode)
     if ckpt_dir is None:
-        result = stream.aggregate(agg, merge_every=merge_every)
+        result = stream.aggregate(
+            agg, merge_every=merge_every,
+            codec_workers=codec_workers, h2d_depth=h2d_depth,
+        )
         labels = None
         for labels in result:
             pass  # continuously-improving summaries; print the final one

@@ -21,6 +21,15 @@ and inside the local functions they call (one level deep):
   (``jnp.array``/``asarray``/``full``/``full_like``/``arange`` without
   ``dtype=``) — weak-typed literals resolve differently under x64,
   splitting the jit cache between CPU tests and TPU runs.
+- ``GL006`` donated argument referenced after the jitted call — a
+  CALLER-side rule, scanned in every function: a name passed in a
+  ``donate_argnums`` position of a donation-jitted callable (a
+  ``jax.jit(fn, donate_argnums=...)`` binding or a
+  ``@partial(jax.jit, donate_argnums=...)`` def) whose buffer is read
+  after the call, or donated inside a loop without the
+  ``state = f(state, ...)`` rebinding idiom. Donation is only enforced
+  on backends that implement it, so this class of bug passes CPU tests
+  and crashes on TPU with "Array has been deleted".
 
 Trace-ness is tracked conservatively: the function's non-static
 parameters are traced, and locals assigned from traced expressions
@@ -79,6 +88,14 @@ RULES: dict[str, tuple[str, str]] = {
         "untyped float literal in a dtype-sensitive constructor",
         "pass dtype= explicitly; weak-typed literals resolve differently "
         "with and without x64, splitting the jit cache",
+    ),
+    "GL006": (
+        "donated argument referenced after the jitted call",
+        "donate_argnums invalidates the caller's buffer at dispatch: "
+        "rebind the result to the same name (state = f(state, x)) or "
+        "drop the reference — reading a donated array afterwards raises "
+        "'Array has been deleted' at runtime (and only on backends that "
+        "implement donation, so CPU tests may pass while TPU crashes)",
     ),
 }
 
@@ -351,6 +368,79 @@ class JitLinter:
         for node in ast.walk(m.tree):
             if isinstance(node, ast.Call) and self._is_pallas_call(m, node):
                 self.expand_pallas_kernel(m, node, via="pallas_call")
+        # GL006 is a CALLER-side rule (donation use-after-free), so it
+        # scans every function — not just jitted ones.
+        donated = self._module_donations(m)
+        for fn in m.all_functions:
+            _DonationLint(self, m, donated).run(fn)
+
+    # ------------------------------------------------ donation (GL006)
+
+    def _jit_donated(self, m: _Module, call: ast.Call) -> list[int]:
+        """Donated positional indices from a ``jax.jit(...)`` call node
+        (``donate_argnums`` int or tuple/list of ints; ``donate_argnames``
+        is not resolvable at the call site and is skipped)."""
+        out: list[int] = []
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    out.append(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    out.extend(e.value for e in v.elts
+                               if isinstance(e, ast.Constant)
+                               and isinstance(e.value, int))
+        return out
+
+    def _donation_from_value(self, m: _Module, node: ast.AST):
+        """Donated positions if ``node`` evaluates to a donation-jitted
+        callable: ``jax.jit(fn, donate_argnums=...)`` or
+        ``partial(jax.jit, donate_argnums=...)``."""
+        if not isinstance(node, ast.Call):
+            return None
+        if self._is_jax_jit(m, node.func):
+            nums = self._jit_donated(m, node)
+            return nums or None
+        chain = _attr_chain(node.func)
+        if (chain and chain[-1] == "partial" and node.args
+                and self._is_jax_jit(m, node.args[0])):
+            nums = self._jit_donated(m, node)
+            return nums or None
+        return None
+
+    def _decorated_donation(self, m: _Module, fn) -> list[int]:
+        """Donated positions from a def's ``@jax.jit(donate_argnums=...)``
+        (or partial-form) decorator; empty when not donation-decorated."""
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call):
+                nums = None
+                if self._is_jax_jit(m, dec.func):
+                    nums = self._jit_donated(m, dec)
+                elif (dec.args and self._is_jax_jit(m, dec.args[0])):
+                    nums = self._jit_donated(m, dec)  # partial form
+                if nums:
+                    return nums
+        return []
+
+    def _module_donations(self, m: _Module) -> dict:
+        """name -> donated positions, for MODULE-LEVEL bindings only:
+        assigned ``jax.jit(..., donate_argnums=...)`` results and
+        decorated defs in ``m.tree.body``. Nested defs are scoped to
+        their defining function by :class:`_DonationLint` instead — a
+        module-wide bare-name registry falsely flagged unrelated
+        same-named locals in other functions (code-review r6)."""
+        donated: dict = {}
+        for node in m.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                nums = self._donation_from_value(m, node.value)
+                if nums:
+                    donated[node.targets[0].id] = nums
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nums = self._decorated_donation(m, node)
+                if nums:
+                    donated[node.name] = nums
+        return donated
 
     @staticmethod
     def _traced_params(fn: ast.FunctionDef, statics, nums) -> set:
@@ -688,6 +778,233 @@ class _FunctionLint:
             elif isinstance(child, ast.comprehension):
                 out |= self._concrete_refs(child.iter)
         return out
+
+
+class _DonationLint:
+    """GL006: donated-argument use-after-free, one function at a time.
+
+    Tracks, in statement order, names passed in a donated position of a
+    donation-jitted callable. The idiomatic ``state = f(state, x)``
+    (rebinding the name in the same statement) is safe; reading the name
+    afterwards is flagged. Loop bodies are walked TWICE (a simulated
+    second iteration) so back-edge hazards fall out of the same rule: a
+    name donated in the body is flagged only if the next iteration reads
+    it before a rebind — a rebind later in the body, or by the ``for``
+    target itself, stays clean. Branches of an ``if``/``try`` are
+    scanned with independent poison sets (they are exclusive at runtime)
+    and re-merged after. Conservative: only plain ``Name`` arguments at
+    statically-resolvable donated positions are tracked, so a miss is
+    possible but a finding is real.
+    """
+
+    def __init__(self, linter: JitLinter, m: _Module, donated: dict):
+        self.linter = linter
+        self.m = m
+        self.module_donated = donated
+        self._emitted: set = set()  # (lineno, name): second-pass dedup
+
+    def run(self, fn) -> None:
+        donated = dict(self.module_donated)
+        # Parameters shadow module-level donation bindings for this
+        # scope: `def g(step, ...)` makes `step` an unknown callable
+        # here, whatever a module-level `step` was jitted with.
+        a = fn.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            donated.pop(arg.arg, None)
+        self._walk(fn.body, donated, {}, fname=fn.name)
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _target_names(node: ast.AST) -> set:
+        out: set = set()
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                out |= _DonationLint._target_names(e)
+        elif isinstance(node, ast.Starred):
+            out |= _DonationLint._target_names(node.value)
+        return out
+
+    @staticmethod
+    def _walk_same_scope(node: ast.AST):
+        """ast.walk that does NOT descend into nested scopes (lambdas,
+        defs, classes) — a donating call inside a deferred closure does
+        not execute at this statement, so it must not poison the
+        enclosing scope (mirrors the scope rule in :meth:`_reads`)."""
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            yield cur
+            for child in ast.iter_child_nodes(cur):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                stack.append(child)
+
+    def _donating_args(self, stmt: ast.stmt, donated: dict):
+        """[(call node, arg Name id), ...] for donated positions filled
+        with plain names anywhere in the statement (same-scope only)."""
+        out = []
+        for node in self._walk_same_scope(stmt):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                nums = donated.get(node.func.id)
+                if not nums:
+                    continue
+                for i in nums:
+                    if i < len(node.args) and isinstance(node.args[i],
+                                                         ast.Name):
+                        out.append((node, node.args[i].id))
+        return out
+
+    @staticmethod
+    def _reads(stmt: ast.stmt) -> set:
+        """Names read at THIS statement's execution time. Nested scopes
+        (lambdas, defs, classes) are pruned: a closure body runs later,
+        possibly after the donated name is rebound, so counting its reads
+        would break the "a finding is real" guarantee."""
+        reads: set = set()
+        for node in _DonationLint._walk_same_scope(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                reads.add(node.id)
+        return reads
+
+    def _emit(self, node: ast.AST, name: str, detail: str, fname: str):
+        key = (getattr(node, "lineno", 0), name)
+        if key in self._emitted:
+            return  # the simulated second loop iteration repeats reads
+        self._emitted.add(key)
+        self.linter._emit(
+            self.m, node, "GL006", f"{name!r} {detail}",
+            via=f"donating call in {fname!r}",
+        )
+
+    def _walk(self, stmts, donated: dict, poisoned: dict,
+              fname: str) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                # A local def/class BINDS its name in this scope: it
+                # shadows any outer donation binding (an unrelated local
+                # callable must not inherit module-level donation), and a
+                # donation-decorated local def becomes trackable from
+                # here on. Its body is a nested scope — never walked.
+                donated.pop(stmt.name, None)
+                poisoned.pop(stmt.name, None)
+                if not isinstance(stmt, ast.ClassDef):
+                    nums = self.linter._decorated_donation(self.m, stmt)
+                    if nums:
+                        donated[stmt.name] = nums
+                continue
+            if isinstance(stmt, ast.If):
+                self._stmt(stmt, donated, poisoned, fname,
+                           reads_only=True)
+                merged: dict = {}
+                for branch in (stmt.body, stmt.orelse):
+                    p = dict(poisoned)
+                    self._walk(branch, donated, p, fname)
+                    merged.update(p)
+                poisoned.clear()
+                poisoned.update(merged)
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                self._stmt(stmt, donated, poisoned, fname,
+                           reads_only=True)
+                # Two symbolic iterations: the second pass carries the
+                # first's poison over the back edge, so "donated in a
+                # loop and read by the next iteration" is just the
+                # ordinary read-after-donation rule — and a rebind later
+                # in the body (or by the for target) clears it.
+                for it in (0, 1):
+                    if isinstance(stmt, ast.For):
+                        for nm in self._target_names(stmt.target):
+                            poisoned.pop(nm, None)
+                            donated.pop(nm, None)  # target shadows
+                    elif it:  # while TEST is re-evaluated per iteration
+                        self._stmt(stmt, donated, poisoned, fname,
+                                   reads_only=True)
+                    self._walk(stmt.body, donated, poisoned, fname)
+                self._walk(stmt.orelse, donated, poisoned, fname)
+                continue
+            if isinstance(stmt, ast.Try):
+                merged = {}
+                for branch in ([stmt.body + stmt.orelse]
+                               + [h.body for h in stmt.handlers]):
+                    p = dict(poisoned)
+                    self._walk(branch, donated, p, fname)
+                    merged.update(p)
+                poisoned.clear()
+                poisoned.update(merged)
+                self._walk(stmt.finalbody, donated, poisoned, fname)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._stmt(stmt, donated, poisoned, fname,
+                           reads_only=True)
+                for item in stmt.items:  # `as name` binds/shadows
+                    if item.optional_vars is not None:
+                        for nm in self._target_names(item.optional_vars):
+                            poisoned.pop(nm, None)
+                            donated.pop(nm, None)
+                self._walk(stmt.body, donated, poisoned, fname)
+                continue
+            self._stmt(stmt, donated, poisoned, fname)
+
+    def _stmt(self, stmt, donated: dict, poisoned: dict,
+              fname: str, reads_only: bool = False) -> None:
+        # 1. Reads of already-poisoned names — the use-after-free.
+        check = stmt if not reads_only else getattr(
+            stmt, "test", None) or getattr(stmt, "iter", None) or stmt
+        if reads_only and isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                for nm in self._reads(item.context_expr) & set(poisoned):
+                    self._emit(item.context_expr, nm,
+                               "read after being donated", fname)
+            return
+        for nm in self._reads(check) & set(poisoned):
+            self._emit(check, nm, "read after being donated", fname)
+            poisoned.pop(nm, None)  # one finding per donation site
+        if reads_only:
+            return
+        # 2. Rebinds clear poison (and define the safe idiom below).
+        rebound: set = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                rebound |= self._target_names(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            rebound |= self._target_names(stmt.target)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                for nm in self._target_names(t):
+                    poisoned.pop(nm, None)
+        for nm in rebound:
+            poisoned.pop(nm, None)
+        # 3. New poisons from donating calls in this statement. Loop
+        # hazards need no special case: the back-edge pass in _walk
+        # re-reads the body with this poison still set.
+        for call, nm in self._donating_args(stmt, donated):
+            if nm in rebound:
+                continue  # state = f(state, ...) — the safe idiom
+            poisoned[nm] = call.lineno
+        # 4. Local donation bindings and aliases. ANY rebind first clears
+        # the name from the donated map (after step 3, which reads the
+        # pre-assignment mapping — the RHS evaluates before the bind): a
+        # plain `step = lambda a, b: a` shadowing a module-level donated
+        # `step` must not keep poisoning its callers' arguments. A
+        # donation value or an alias of a donated name then re-adds it.
+        for nm in rebound:
+            donated.pop(nm, None)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            tgt = stmt.targets[0].id
+            nums = self.linter._donation_from_value(self.m, stmt.value)
+            if nums:
+                donated[tgt] = nums
+            elif isinstance(stmt.value, ast.Name) \
+                    and stmt.value.id in donated:
+                donated[tgt] = donated[stmt.value.id]
 
 
 def lint_paths(package_root: str, paths) -> list[Finding]:

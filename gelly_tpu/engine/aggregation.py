@@ -34,6 +34,7 @@ merge+Merger+emit sequence.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Any, Callable, Iterator
 
@@ -45,6 +46,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.chunk import EdgeChunk, split_chunk_host
 from ..parallel import collectives, mesh as mesh_lib, partition
 from ..parallel.mesh import SHARD_AXIS
+from . import faults as faults_mod
 
 Summary = Any
 
@@ -109,6 +111,14 @@ class SummaryAggregation:
     # error path so the codec can release the turn (idempotent if the
     # unit already completed it).
     on_stage_error: Callable[[int], None] | None = None
+    # With stack_ordered, cumulative seconds stagers have spent blocked in
+    # the codec's ordered-turn gate (CompactIdSession.await_turn). The
+    # engine samples it at run start and teardown and reattributes the
+    # delta from ``ingest_compress`` to a ``codec_wait`` timer stage:
+    # turn-wait is pipeline serialization, not compress work, and booking
+    # it as busy would overstate the serial-cost side of the overlap
+    # accounting (a serial run never waits here).
+    ordered_wait_s: Callable[[], float] | None = None
     # SummaryTreeReduce's degree knob (M/SummaryTreeReduce.java:75): when
     # set, the cross-shard combine runs as a two-phase hierarchical tree —
     # groups of S/degree shards merge first (ICI-local), then across groups
@@ -129,6 +139,33 @@ class SummaryAggregation:
     # instance re-jits (rather than silently reusing stale executables)
     # if a caller rebuilds its folds for a different backend.
     fold_backend: str = "xla"
+    # Cross-shard window-merge strategy ("replicated" | "delta" | "auto").
+    # The replicated merges (butterfly / hierarchical tree / gather) move
+    # FULL per-shard summaries — cost ∝ capacity per window regardless of
+    # how little the window touched. A plan that supplies ``merge_delta``
+    # can instead exchange only the dirty entries its folds marked:
+    #
+    # - ``merge_dirty_count(local_summary) -> i32`` — per-shard count of
+    #   dirty entries (pure jnp; the engine wraps it in shard_map and
+    #   reads the max once per window close to size the gather bucket);
+    # - ``merge_delta(base, local_summary, bucket) -> summary`` — runs
+    #   per-shard INSIDE shard_map: compact this shard's dirty rows to
+    #   ``bucket`` lanes (collectives.compact_delta), all_gather every
+    #   shard's rows (collectives.gather_delta), and apply them to the
+    #   replicated ``base`` (the carried global summary). Replaces BOTH
+    #   the cross-shard merge and the Merger combine in one program, so
+    #   window-merge cost is ∝ hooks-since-last-merge, not capacity.
+    #
+    # "auto" decides per window from the measured count: delta while the
+    # gathered rows (S * bucket) stay under ``merge_delta_auto_rows``,
+    # else the plan's replicated merge. Deltas are measured against a
+    # window-fresh locals (init()), which the engine guarantees by
+    # rebuilding locals at every window close. Like fold_backend, the
+    # compiled-plan cache keys on merge_mode.
+    merge_mode: str = "replicated"
+    merge_delta: Callable[..., Summary] | None = None
+    merge_dirty_count: Callable[[Summary], Any] | None = None
+    merge_delta_auto_rows: int | None = None
     # True for plans whose fold exists ONLY through the ingest codec (the
     # compact-space plans: raw chunks carry ids the summary's compact space
     # has no mapping for). The engine then refuses — loudly, at plan time —
@@ -152,6 +189,13 @@ class SummaryAggregation:
 # payload (n_v * ~4 bytes) is smaller/cheaper than touched-slot pairs;
 # above it the dense payload inverts the codec's wire compression.
 SPARSE_CODEC_MIN_CAPACITY = 1 << 20
+
+# Smallest dirty-delta gather bucket (pow-2 ladder floor): keeps the
+# per-window program count bounded and lets merge_mode="auto" prove at
+# PLAN time that delta can never win on tiny capacities (S * floor already
+# above the plan's auto-rows bound) — those plans skip the count program
+# entirely instead of paying a per-window D2H for a foregone decision.
+DELTA_MERGE_MIN_BUCKET = 256
 
 
 def available_cores() -> int:
@@ -319,7 +363,7 @@ def _compiled_plan(agg: SummaryAggregation, m):
     # each time (~10s/program over the TPU tunnel). Storing on the instance
     # ties the cache (and its compiled executables) to the agg's lifetime.
     key = (tuple(d.id for d in m.devices.flat), m.axis_names,
-           agg.fold_backend)
+           agg.fold_backend, agg.merge_mode)
     per_agg = agg.__dict__.setdefault("_plan_cache", {})
     if key in per_agg:
         return per_agg[key]
@@ -329,26 +373,42 @@ def _compiled_plan(agg: SummaryAggregation, m):
     unshard_leaf = lambda tree: jax.tree.map(lambda l: l[0], tree)
     sharded = NamedSharding(m, P(SHARD_AXIS))
 
+    # Fresh [S, ...]-stacked local summaries, rebuilt at EVERY window
+    # close (folds donate their input, so a shared locals0 object would
+    # be consumed by the first fold that sees it). Jitted so the rebuild
+    # is one cached on-device dispatch — the eager host-broadcast +
+    # device_put version costs a full H2D per window, which at
+    # merge_every=1 means per chunk.
+    @partial(jax.jit, out_shardings=sharded)
     def locals0_fn():
-        # Fresh [S, ...]-stacked local summaries; rebuilt per run (cheap),
-        # reused at every window close (jax arrays are immutable).
-        return mesh_lib.device_put_sharded_leading(
-            m,
-            jax.tree.map(
-                lambda l: jnp.broadcast_to(l[None], (S,) + l.shape), agg.init()
-            ),
+        return jax.tree.map(
+            lambda l: jnp.broadcast_to(l[None], (S,) + l.shape), agg.init()
         )
 
+    # Fold state is DONATED (donate_argnums=0): the steady-state pipeline
+    # re-dispatches the fold dozens of times per merge window, and without
+    # donation every dispatch allocates a fresh full-capacity summary.
+    # With it, XLA writes the new summary into the old one's buffers —
+    # zero allocation after the first fold. The engine upholds the
+    # donation contract by never reading a summary object after passing it
+    # to a fold (locals are rebound by every fold call and rebuilt fresh
+    # at each window close; see close_window). The jitlint GL006 rule
+    # guards the same contract statically. The ONE plan shape where a
+    # summary ESCAPES to the caller is the accumulate plan without a
+    # transform: close_window yields the live fold state itself, and a
+    # donated next fold would delete the consumer's held emission out
+    # from under it — donation stays off exactly there.
+    accum_plan = agg.fold_accumulates and not agg.transient and S == 1
+    donate = () if (accum_plan and agg.transform is None) else (0,)
     if S == 1:
         # Single-shard specialization: the shard_map + collective plumbing
         # is identity at S=1 and only adds dispatch/layout overhead.
-        def locals0_fn():  # noqa: F811
-            return jax.device_put(agg.init())
+        locals0_fn = jax.jit(agg.init)  # noqa: F811
 
-        fold_step = jax.jit(agg.fold)
+        fold_step = jax.jit(agg.fold, donate_argnums=donate)
         merge_locals = jax.jit(lambda s: s)
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=donate)
         def fold_many(s, stacked_chunk):
             # K chunks in one dispatch: scan the fold over the stacked
             # leading axis. Dispatch round-trips (~15ms each on a tunneled
@@ -360,11 +420,11 @@ def _compiled_plan(agg: SummaryAggregation, m):
             return s
 
         if agg.fold_compressed is not None:
-            fold_codec = jax.jit(agg.fold_compressed)
+            fold_codec = jax.jit(agg.fold_compressed, donate_argnums=donate)
         else:
             fold_codec = None
     else:
-        @partial(jax.jit, out_shardings=sharded)
+        @partial(jax.jit, out_shardings=sharded, donate_argnums=0)
         def fold_step(locals_, chunk):
             # Split fused into the same program as the fold: one dispatch
             # per chunk (dispatch round-trips dominate on a tunneled device).
@@ -400,7 +460,7 @@ def _compiled_plan(agg: SummaryAggregation, m):
             # All shards hold the identical global merge; take shard 0.
             return unshard_leaf(merged)
 
-        @partial(jax.jit, out_shardings=sharded)
+        @partial(jax.jit, out_shardings=sharded, donate_argnums=0)
         def fold_many(locals_, stacked_chunk):
             # K chunks in one dispatch on the sharded raw path (VERDICT r2
             # item 7): each chunk of the host-stacked [K, C] batch splits
@@ -433,7 +493,7 @@ def _compiled_plan(agg: SummaryAggregation, m):
             # Codec payloads are data-parallel over the chunk axis: a batch
             # of K payloads arrives as [S, K/S, ...]-sharded leaves and each
             # device folds its K/S payloads into its local summary.
-            @partial(jax.jit, out_shardings=sharded)
+            @partial(jax.jit, out_shardings=sharded, donate_argnums=0)
             def fold_codec(locals_, payload):
                 def body(loc, pl):
                     s = unshard_leaf(loc)
@@ -453,6 +513,80 @@ def _compiled_plan(agg: SummaryAggregation, m):
         # incremental non-blocking global combine.
         return agg.combine(window_summary, global_summary)
 
+    # Dirty-delta merge programs (merge_mode="delta"/"auto", S > 1 plans
+    # that supply merge_delta): one tiny count program sizing the gather
+    # bucket, and one merge program per bucket (a bounded pow-2 ladder —
+    # O(log capacity) distinct programs per stream). The merge fuses the
+    # cross-shard merge AND the Merger combine: it applies every shard's
+    # gathered dirty rows directly to the carried global summary, so the
+    # per-window merge cost is ∝ hooks, not ∝ capacity.
+    delta_count_fn = None
+    merge_delta_for = None
+    if agg.merge_mode not in ("replicated", "delta", "auto"):
+        # Fail loudly like every other plan knob: a typo'd mode on a
+        # hand-built SummaryAggregation would otherwise silently run the
+        # capacity-proportional replicated merge — the exact wall the
+        # delta path exists to avoid. (Library plans validate earlier in
+        # resolve_merge_mode; the engine is a public path too.)
+        raise ValueError(
+            f"plan {agg.name!r}: merge_mode must be 'replicated', "
+            f"'delta' or 'auto', got {agg.merge_mode!r}"
+        )
+    if S > 1 and agg.merge_mode == "delta" and agg.merge_delta is None:
+        raise ValueError(
+            f"plan {agg.name!r} sets merge_mode='delta' but supplies no "
+            "merge_delta — the delta merge is summary-specific and must "
+            "come from the plan (see SummaryAggregation.merge_delta); "
+            "use merge_mode='replicated' for plans without one"
+        )
+    if (S > 1 and agg.merge_delta is not None
+            and (agg.merge_mode == "delta"
+                 or (agg.merge_mode == "auto"
+                     and agg.merge_delta_auto_rows is not None
+                     and S * DELTA_MERGE_MIN_BUCKET
+                     <= agg.merge_delta_auto_rows))):
+        if agg.merge_dirty_count is None:
+            raise ValueError(
+                f"plan {agg.name!r} supplies merge_delta without "
+                "merge_dirty_count — the engine sizes the delta gather "
+                "bucket from the measured count; supply both or neither"
+            )
+
+        @jax.jit
+        def delta_count_fn(locals_):  # noqa: F811
+            def body(loc):
+                return agg.merge_dirty_count(unshard_leaf(loc))[None]
+
+            return mesh_lib.shard_map_fn(
+                m, body, in_specs=(P(SHARD_AXIS),),
+                out_specs=P(SHARD_AXIS),
+            )(locals_)
+
+        _delta_cache: dict = {}
+
+        def merge_delta_for(bucket):  # noqa: F811
+            fn = _delta_cache.get(bucket)
+            if fn is None:
+                @jax.jit
+                def fn(locals_, global_summary):
+                    def body(loc, g):
+                        merged = agg.merge_delta(
+                            g, unshard_leaf(loc), bucket
+                        )
+                        return shard_leaf(merged)
+
+                    out = mesh_lib.shard_map_fn(
+                        m, body, in_specs=(P(SHARD_AXIS), P()),
+                        out_specs=P(SHARD_AXIS),
+                    )(locals_, global_summary)
+                    # Every shard applied the identical gathered delta to
+                    # the identical base; take shard 0 (same convention
+                    # as merge_locals).
+                    return unshard_leaf(out)
+
+                _delta_cache[bucket] = fn
+            return fn
+
     # transform runs jitted by default: an eager lax.while_loop (e.g. the CC
     # label pointer-jump) re-dispatches per call and dominates the window
     # cost. Host-side transforms set jit_transform=False.
@@ -464,7 +598,8 @@ def _compiled_plan(agg: SummaryAggregation, m):
         transform_fn = agg.transform
 
     plan = (fold_step, merge_locals, merger_step, locals0_fn,
-            transform_fn, fold_many, fold_codec)
+            transform_fn, fold_many, fold_codec, delta_count_fn,
+            merge_delta_for)
     per_agg[key] = plan
     return plan
 
@@ -483,6 +618,8 @@ def run_aggregation(
     host_precombine: Callable | None = None,
     fold_batch: int = 1,
     ingest_workers: int | None = None,
+    codec_workers: int | None = None,
+    h2d_depth: int | None = None,
     allowed_lateness: int = 0,
     timer=None,
 ) -> SummaryStream:
@@ -528,10 +665,37 @@ def run_aggregation(
     payloads per dispatch: more per-dispatch host memory/latency than
     requested, but the only aligned batching).
 
-    ``timer`` (a ``utils.metrics.StageTimer``) accumulates per-stage
-    wall-clock: ``ingest_compress`` / ``h2d`` (prefetch thread),
-    ``fold_dispatch`` / ``merge_emit`` (consumer). Also exposed as
+    ``timer`` (a ``utils.metrics.StageTimer``) accumulates per-stage BUSY
+    time: ``ingest_compress`` (codec worker pool), ``h2d`` (the dedicated
+    transfer thread), ``fold_dispatch`` / ``merge_emit`` (consumer).
+    Stages overlap, so their sum can exceed — and with a healthy pipeline
+    total wall SHOULD undercut — the serial sum. Also exposed as
     ``stream.timer``.
+
+    **Pipelined executor** (merge_every mode): the fold path runs as a
+    three-stage pipeline —
+
+      produce → [K codec workers: host compress]
+              → [1 H2D thread: device_put chunk i+1 while chunk i folds]
+              → [consumer: async fold dispatch]
+
+    ``codec_workers`` (alias of ``ingest_workers``; passing both raises)
+    sizes the compress pool; ``h2d_depth`` bounds the transferred units
+    resident on device ahead of the fold (default 2 — classic double
+    buffering; 0 stages transfers inline on the consumer). Fold state is
+    donated (``donate_argnums``), so steady-state folds reallocate
+    nothing, and the consumer synchronizes ONCE per merge window (the
+    ``merge_emit`` block) instead of per chunk.
+
+    **Exactly-once resume — the last-retired-chunk rule**: the recorded
+    checkpoint position counts only chunks whose fold was *dispatched*
+    (retired from the pipeline); units still in the compress/H2D double
+    buffers are NOT counted. The snapshot's device_get barrier guarantees
+    every retired fold is in the snapshot, so resume re-reads exactly the
+    un-retired suffix — bit-identical to an uninterrupted run even when
+    the crash lands with chunks in flight (stateful codec sessions are
+    rebuilt from the restored summary via ``on_resume``, dropping any
+    staged-but-unfolded assignments).
     """
     if merge_every is not None and window_ms is not None:
         raise ValueError("pass at most one of merge_every / window_ms")
@@ -549,6 +713,17 @@ def run_aggregation(
                 f"merge_degree must be a positive power of two, got {d}"
             )
 
+    if codec_workers is not None:
+        if ingest_workers is not None:
+            raise ValueError(
+                "pass codec_workers or ingest_workers, not both (they are "
+                "the same knob; codec_workers is the executor-facing name)"
+            )
+        ingest_workers = codec_workers
+    if h2d_depth is None:
+        h2d_depth = 2  # double buffer: chunk i+1 transfers while i folds
+    if h2d_depth < 0:
+        raise ValueError(f"h2d_depth must be >= 0, got {h2d_depth}")
     if ingest_workers is None:
         # One codec worker per AVAILABLE core (affinity/cgroup-aware, not
         # installed count): the native combiners release the GIL, so
@@ -572,8 +747,8 @@ def run_aggregation(
     S = mesh_lib.num_shards(m)
     plan = _compiled_plan(agg, m)
     (fold_step, merge_locals, merger_step, locals0_fn,
-     transform_fn, fold_many, fold_codec) = plan
-    locals0 = locals0_fn()
+     transform_fn, fold_many, fold_codec, delta_count_fn,
+     merge_delta_for) = plan
 
     if timer is None:
         from ..utils.metrics import StageTimer
@@ -615,7 +790,8 @@ def run_aggregation(
             "multiple of the shard count)"
         )
 
-    stats = {"late_edges": 0, "windows_closed": 0, "chunks": 0}
+    stats = {"late_edges": 0, "windows_closed": 0, "chunks": 0,
+             "merge_modes": {"delta": 0, "replicated": 0}}
 
     # The accumulate plan (see SummaryAggregation.fold_accumulates): one
     # running summary, no per-window Merger combine.
@@ -624,7 +800,17 @@ def run_aggregation(
     def gen():
         if agg.on_run_start is not None:
             agg.on_run_start()
-        locals_ = locals0
+        # Ordered-wait baseline for this run (the codec session resets in
+        # on_run_start, but sample rather than assume zero): the delta to
+        # teardown is reclassified ingest_compress -> codec_wait.
+        wait0 = (
+            agg.ordered_wait_s() if agg.ordered_wait_s is not None else 0.0
+        )
+        # Fresh locals per run AND per window (never a shared ``locals0``
+        # object): folds donate their summary argument, so a reused
+        # initial summary would be consumed by the first fold that sees
+        # it and poison every later window.
+        locals_ = locals0_fn()
         global_summary = agg.init()
         current_window = None
         dirty = False  # locals hold edges not yet merged into a window result
@@ -698,19 +884,42 @@ def run_aggregation(
                     transform_fn(global_summary)
                     if transform_fn else global_summary
                 )
-            window_summary = merge_locals(locals_)
+            merged = None
+            if delta_count_fn is not None:
+                # Measured per-window decision: one scalar D2H (the count)
+                # sizes the gather bucket; the delta program fuses the
+                # cross-shard merge and the Merger combine, so the close
+                # moves S * bucket dirty rows instead of S full summaries.
+                count = int(np.max(np.asarray(delta_count_fn(locals_))))
+                bucket = max(DELTA_MERGE_MIN_BUCKET,
+                             1 << max(0, count - 1).bit_length())
+                limit = agg.merge_delta_auto_rows
+                if agg.merge_mode == "delta" or (
+                    limit is not None and S * bucket <= limit
+                ):
+                    merged = merge_delta_for(bucket)(locals_, global_summary)
+                    stats["merge_modes"]["delta"] += 1
+            if merged is None:
+                # Replicated path (the reference Merger shape): full
+                # cross-shard merge, then combine into the global summary.
+                # Counted here — not in the delta-decision else — so
+                # replicated-only plans (merge_mode="replicated", S == 1,
+                # no merge_delta) report their merges too.
+                window_summary = merge_locals(locals_)
+                merged = merger_step(window_summary, global_summary)
+                stats["merge_modes"]["replicated"] += 1
             if agg.transient:
                 # Reference Merger with transientState: emit
                 # combine(input, summary) then reset summary to the initial
                 # value (M/SummaryAggregation.java:107-119). `init` must be
                 # the combine identity. After a resume, global carries the
                 # restored partial window and is folded into the first emit.
-                out = merger_step(window_summary, global_summary)
+                out = merged
                 global_summary = agg.init()
             else:
-                global_summary = merger_step(window_summary, global_summary)
+                global_summary = merged
                 out = global_summary
-            locals_ = locals0
+            locals_ = locals0_fn()
             dirty = False
             windows_closed += 1
             stats["windows_closed"] = windows_closed
@@ -779,29 +988,16 @@ def run_aggregation(
 
         from ..utils.prefetch import prefetch
 
-        def stage(c):
-            # Window mode needs ts/valid host-side (the tumbling iterator
-            # reads them per chunk); skip pre-staging there.
-            if window_ms is not None:
-                return c
-            if host_precombine is not None:
-                c = host_precombine(c)
-            if device_fields:
-                c = c._replace(**{
-                    f: jax.device_put(getattr(c, f)) for f in device_fields
-                })
-            return c
-
         def counted_chunks():
-            # Chunks stay host-side through the prefetch queue: jit prunes
-            # dead arguments at dispatch, so only the fields the fold
-            # actually reads are transferred (an explicit full device_put
-            # would upload all 8 — ~3x the bytes on a bandwidth-limited
-            # link), and the tumbling iterator reads ts/valid on the host.
-            # device_fields moves exactly the hot fields' H2D onto the
-            # prefetch thread to overlap the folds.
+            # Window-mode ingest: chunks stay host-side through the
+            # prefetch queue — the tumbling iterator reads ts/valid per
+            # chunk on the host, and jit prunes dead arguments at
+            # dispatch so only the fields the fold actually reads are
+            # transferred. (The merge_every path's precombine and
+            # device_fields H2D staging live in stage_unit/h2d_unit;
+            # this iterator feeds window mode only.)
             nonlocal chunks_consumed
-            for chunk in prefetch(map(stage, iter(stream)), prefetch_depth):
+            for chunk in prefetch(iter(stream), prefetch_depth):
                 # In window mode checkpoints fire only here, at chunk
                 # boundaries: every edge of the chunks counted so far is in
                 # locals_ or global_summary, so the recorded position is
@@ -861,8 +1057,13 @@ def run_aggregation(
             identity_payload = agg.host_compress(empty)
 
         def stage_unit(unit):
+            # Pipeline stage 1 — HOST compress only (the K-worker pool):
+            # builds the unit's host payload; the H2D transfer is stage 2
+            # (h2d_unit, a dedicated thread), so compress of unit i+2,
+            # transfer of unit i+1 and the fold of unit i all overlap.
             seq, group = unit
             try:
+                faults_mod.inject("codec")
                 return _stage_unit_inner(seq, group)
             except BaseException:
                 # Release the unit's assignment turn so units parked
@@ -904,18 +1105,9 @@ def run_aggregation(
                             ),
                             stacked,
                         )
-                with timer("h2d"):
-                    if S > 1:
-                        dev = mesh_lib.device_put_sharded_leading(m, stacked)
-                    else:
-                        dev = jax.device_put(stacked)
-                    # Block on the prefetch thread (not the consumer): the
-                    # recorded h2d time is the real transfer, and the fold
-                    # dispatch never waits on an in-flight upload.
-                    jax.block_until_ready(dev)
-                return dev, k
-            if batch > 1:
-                with timer("ingest_compress"):
+                return stacked, k
+            with timer("ingest_compress"):
+                if batch > 1:
                     group = [
                         host_precombine(c) if host_precombine else c
                         for c in group
@@ -924,14 +1116,39 @@ def run_aggregation(
                     stacked = EdgeChunk(
                         *(np.stack(fs) for fs in zip(*group))
                     )
-                with timer("h2d"):
-                    if device_fields:
-                        stacked = stacked._replace(**{
-                            f: jax.device_put(getattr(stacked, f))
-                            for f in device_fields
-                        })
-                return stacked, k
-            return stage(group[0]), k
+                    return stacked, k
+                c = group[0]
+                if host_precombine is not None:
+                    c = host_precombine(c)
+                return c, k
+
+        def h2d_unit(staged):
+            # Pipeline stage 2 — the double-buffered H2D leg: device_put
+            # of unit i+1 is issued (and, with h2d_depth > 0, completed on
+            # its own thread) while the fold of unit i is in flight. The
+            # block lands HERE, not on the consumer, so the recorded h2d
+            # time is the real transfer and the fold dispatch never waits
+            # on an in-flight upload.
+            payload, k = staged
+            faults_mod.inject("h2d")
+            with timer("h2d"):
+                if use_codec:
+                    if S > 1:
+                        dev = mesh_lib.device_put_sharded_leading(m, payload)
+                    else:
+                        dev = jax.device_put(payload)
+                    jax.block_until_ready(dev)
+                elif device_fields:
+                    dev = payload._replace(**{
+                        f: jax.device_put(getattr(payload, f))
+                        for f in device_fields
+                    })
+                    jax.block_until_ready(
+                        [getattr(dev, f) for f in device_fields]
+                    )
+                else:
+                    dev = payload
+            return dev, k
 
         if window_ms is not None:
             # Tumbling timestamp windows via the shared iterator
@@ -1012,27 +1229,85 @@ def run_aggregation(
                 fold_unit = fold_step
             from ..utils.prefetch import prefetch_map
 
-            for unit, k in prefetch_map(
+            # The pipelined executor: compress on K workers, H2D on its
+            # own thread (h2d_depth in-flight device buffers), folds
+            # dispatched asynchronously by this consumer. The only
+            # consumer-side synchronization is the merge_emit block at
+            # each window close — steady-state folds neither block nor
+            # allocate (state is donated).
+            pipe_cancel = threading.Event()
+            staged = prefetch_map(
                 stage_unit, produced_units(), depth=prefetch_depth,
-                workers=ingest_workers,
-            ):
-                chunks_consumed += k
-                stats["chunks"] = chunks_consumed
-                with timer("fold_dispatch"):
-                    locals_ = fold_unit(locals_, unit)
-                chunks_in_window += k
-                dirty = True
-                if chunks_in_window >= merge_every:
+                workers=ingest_workers, cancel=pipe_cancel,
+            )
+            transferred = map(h2d_unit, staged)
+            if h2d_depth > 0:
+                transferred = prefetch(transferred, depth=h2d_depth)
+            try:
+                for unit, k in transferred:
+                    # Last-retired-chunk rule: a chunk counts toward the
+                    # checkpoint position exactly when its fold is
+                    # dispatched here; units still in the compress/H2D
+                    # buffers are re-read on resume.
+                    chunks_consumed += k
+                    stats["chunks"] = chunks_consumed
+                    with timer("fold_dispatch"):
+                        locals_ = fold_unit(locals_, unit)
+                    chunks_in_window += k
+                    dirty = True
+                    if chunks_in_window >= merge_every:
+                        with timer("merge_emit"):
+                            out = close_window()
+                            # The window's ONE completion barrier: the
+                            # emission (and with it every fold of the
+                            # window) is ready before it is yielded.
+                            jax.block_until_ready(out)
+                        chunks_in_window = 0
+                        yield out
+                    maybe_checkpoint()
+                if dirty:
                     with timer("merge_emit"):
                         out = close_window()
-                    chunks_in_window = 0
+                        jax.block_until_ready(out)
                     yield out
-                maybe_checkpoint()
-            if dirty:
-                with timer("merge_emit"):
-                    out = close_window()
-                yield out
-                maybe_checkpoint(force=True)
+                    maybe_checkpoint(force=True)
+            finally:
+                # Tear the pipeline down outermost-first on ANY exit —
+                # normal end, error, or the caller abandoning the
+                # emission generator mid-stream. ``pipe_cancel`` goes
+                # FIRST: the H2D prefetch thread may be parked inside
+                # ``staged.__next__`` on a stalled source, where a
+                # generator close cannot reach it ("generator already
+                # executing") — the event ends that parked get within
+                # one poll, making the closes below deterministic rather
+                # than best-effort, so abandoning the emission stream can
+                # never leave compress workers consuming the source (and
+                # advancing a stateful codec session) in the background.
+                import time as _time
+
+                pipe_cancel.set()
+                close = getattr(transferred, "close", None)
+                if close is not None:
+                    close()
+                deadline = _time.monotonic() + 2.0
+                while True:
+                    try:
+                        staged.close()
+                        break
+                    except ValueError:
+                        if _time.monotonic() >= deadline:
+                            break  # daemon threads; cancel backstop
+                        _time.sleep(0.01)
+                if agg.ordered_wait_s is not None:
+                    # Compress workers are torn down: move the turn-wait
+                    # they accrued this run out of the compress stage —
+                    # await_turn blocks INSIDE the ingest_compress timer
+                    # context, and with K workers that wait would read as
+                    # busy compress time in the overlap accounting.
+                    timer.reattribute(
+                        "ingest_compress", "codec_wait",
+                        agg.ordered_wait_s() - wait0,
+                    )
 
     out_stream = SummaryStream(gen)
     out_stream.stats = stats

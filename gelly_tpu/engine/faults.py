@@ -8,6 +8,7 @@ the places the resilient driver (``engine/resilience.py``) and the native
 bindings (``utils/native.py``) call :func:`inject`:
 
 - ``"native"``            — entry of a ctypes call into a native library
+- ``"codec"``             — a codec worker staging a unit (host compress)
 - ``"h2d"``               — host→device staging of a chunk
 - ``"step"``              — the jitted ``step(state, chunk)`` dispatch
 - ``"source"``            — the chunk source / prefetch worker
@@ -36,6 +37,7 @@ from typing import Callable, Iterator, Sequence
 
 BOUNDARIES = (
     "native",
+    "codec",
     "h2d",
     "step",
     "source",

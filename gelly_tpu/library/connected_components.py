@@ -149,7 +149,7 @@ def merge_chunk_forest(glob: np.ndarray, lab: np.ndarray) -> np.ndarray:
 def connected_components_compact(
     vertex_capacity: int, merge: str = "gather",
     compact_capacity: int | None = None, wire: str = "auto",
-    unit_block: int = 1 << 18,
+    unit_block: int = 1 << 18, merge_mode: str = "auto",
 ) -> SummaryAggregation:
     """CC over a **persistent compact root space** — the large-N fast path
     (``codec="compact"``).
@@ -418,6 +418,42 @@ def connected_components_compact(
             vertex_of=jnp.max(st.vertex_of, axis=0),
         )
 
+    def merge_dirty_count(local: CCCompactSummary) -> jax.Array:
+        # A window's locals touch a cid either by assigning its decode
+        # entry (fresh cids: vertex_of >= 0) or by hooking its root (cids
+        # from earlier windows: croot moved off the identity).
+        dirty = (local.vertex_of >= 0) | (
+            local.croot != jnp.arange(m, dtype=jnp.int32)
+        )
+        return jnp.sum(dirty.astype(jnp.int32))
+
+    def merge_delta(base: CCCompactSummary, local: CCCompactSummary,
+                    bucket: int) -> CCCompactSummary:
+        # Dirty-delta mesh merge in cid space: gather (cid, croot,
+        # vertex_of) rows for the window's touched cids only. croot rows
+        # are union edges (same argument as the CCSummary delta); each
+        # cid's vertex is recorded by exactly one row globally, so the
+        # max-scatter reproduces the elementwise-max decode-table merge.
+        from ..parallel import collectives
+
+        dirty = (local.vertex_of >= 0) | (
+            local.croot != jnp.arange(m, dtype=jnp.int32)
+        )
+        slots, vals, _ = collectives.compact_delta(
+            dirty, {"r": local.croot, "v": local.vertex_of}, bucket
+        )
+        gs, gv = collectives.gather_delta(slots, vals)
+        ok = gs >= 0
+        si = jnp.where(ok, gs, 0)
+        ri = jnp.where(ok, gv["r"], 0)
+        # Rows-proportional apply (see _cc_merge_delta): no full-capacity
+        # flatten; transform's pointer_jump chases through the depth.
+        croot = unionfind.union_pairs_rooted(base.croot, si, ri, ok)
+        vertex_of = base.vertex_of.at[jnp.where(ok, gs, m)].max(
+            jnp.where(ok, gv["v"], -1), mode="drop"
+        )
+        return CCCompactSummary(croot, vertex_of)
+
     def transform(s: CCCompactSummary) -> jax.Array:
         # The ONLY full-capacity op in the plan: materialize i32[n] labels
         # once per window close.
@@ -446,14 +482,84 @@ def connected_components_compact(
         stack_ordered=True,
         on_stage_error=session.complete_turn,
         on_run_start=session.reset,
+        ordered_wait_s=lambda: session.wait_s,
         on_resume=lambda summary: session.rebuild_from_vertex_of(
             np.asarray(summary.vertex_of)
         ),
+        merge_mode=resolve_merge_mode(merge_mode),
+        merge_delta=merge_delta,
+        merge_dirty_count=merge_dirty_count,
+        merge_delta_auto_rows=m // 4,
         name="connected-components-compact",
     )
     agg.session = session
     agg.compact_capacity = m
     return agg
+
+
+def resolve_merge_mode(merge_mode: str) -> str:
+    """Shared ``merge_mode=`` knob semantics for the cross-shard window
+    merge: validate ``"auto"``/``"delta"``/``"replicated"``.
+
+    - ``"replicated"`` — the full-summary merge (butterfly / hierarchical
+      tree / gather+stacked union): cost ∝ capacity per window, the
+      BENCH_r05 ``sharded_state_cc`` wall (0.58s → 32.2s from 1M → 16M
+      slots at a fixed pair count).
+    - ``"delta"`` — all_gather only the dirty ``(slot, parent)`` entries
+      the window's folds marked and union them into the carried global
+      summary: merge cost ∝ hooks-since-last-merge.
+    - ``"auto"`` — per-window measured decision: the engine counts the
+      dirty entries (one scalar D2H per window close) and takes the delta
+      path while the gathered rows stay under the plan's
+      ``merge_delta_auto_rows`` bound, falling back to the replicated
+      merge (the plan's configured tree — hierarchical when
+      ``merge_degree`` is set) on dense windows.
+    """
+    if merge_mode not in ("auto", "delta", "replicated"):
+        raise ValueError(
+            f"merge_mode must be auto/delta/replicated, got {merge_mode!r}"
+        )
+    return merge_mode
+
+
+def _cc_merge_delta(n: int):
+    """Build the CCSummary dirty-delta merge (runs per-shard inside
+    ``shard_map``): compact this shard's touched ``(slot, parent)``
+    entries, all_gather every shard's rows, and union them into the
+    replicated base summary. Exact: a fresh-forest local summary IS its
+    edge set ``{(i, parent[i])}`` plus the seen marks, so applying the
+    gathered pairs to the base is the same merge ``merge_forest_stack``
+    computes — minus the ``S × capacity`` traffic."""
+    from ..parallel import collectives
+
+    def merge_dirty_count(local: CCSummary) -> jax.Array:
+        dirty = local.seen | (
+            local.parent != jnp.arange(n, dtype=jnp.int32)
+        )
+        return jnp.sum(dirty.astype(jnp.int32))
+
+    def merge_delta(base: CCSummary, local: CCSummary,
+                    bucket: int) -> CCSummary:
+        dirty = local.seen | (
+            local.parent != jnp.arange(n, dtype=jnp.int32)
+        )
+        slots, vals, _ = collectives.compact_delta(
+            dirty, local.parent, bucket
+        )
+        gs, gv = collectives.gather_delta(slots, vals)
+        ok = gs >= 0
+        si = jnp.where(ok, gs, 0)
+        vi = jnp.where(ok, gv, 0)
+        # union_pairs_rooted: EVERY per-round op is sized to the gathered
+        # rows (pair-sized chases + one scatter-min), and no full-capacity
+        # flatten — the whole point of the delta merge. Depth grows O(1)
+        # per window; the transform's label chase and later merges chase
+        # through it (their documented contract).
+        parent = unionfind.union_pairs_rooted(base.parent, si, vi, ok)
+        seen = base.seen.at[jnp.where(ok, gs, n)].set(True, mode="drop")
+        return CCSummary(parent, seen)
+
+    return merge_delta, merge_dirty_count
 
 
 def resolve_fold_backend(fold_backend: str, vertex_capacity: int) -> str:
@@ -487,7 +593,7 @@ def resolve_fold_backend(fold_backend: str, vertex_capacity: int) -> str:
 def connected_components(
     vertex_capacity: int, merge: str = "tree", ingest_combine: bool = True,
     codec: str = "auto", compact_capacity: int | None = None,
-    fold_backend: str = "auto",
+    fold_backend: str = "auto", merge_mode: str = "auto",
 ) -> SummaryAggregation:
     """Build the CC aggregation over a slot space of ``vertex_capacity``.
 
@@ -521,6 +627,13 @@ def connected_components(
     - ``"auto"`` (default) — sparse iff ``vertex_capacity >=``
       :data:`SPARSE_CODEC_MIN_CAPACITY` (2^20).
 
+    ``merge_mode`` picks the cross-shard window merge
+    (:func:`resolve_merge_mode`): ``"delta"`` gathers only the window's
+    dirty ``(slot, parent)`` entries (merge ∝ hooks, not capacity),
+    ``"replicated"`` keeps the full-summary merge, ``"auto"`` (default)
+    measures the dirty count each window close and picks per window.
+    Like ``fold_backend``, the engine's compiled-plan cache keys on it.
+
     ``fold_backend`` picks the RAW device fold's kernel backend
     (:func:`resolve_fold_backend`): ``"pallas"`` routes the large-chunk
     sort-dedup fold's sorted chases through the VMEM-blocked gather
@@ -539,10 +652,12 @@ def connected_components(
             raise ValueError("codec='compact' requires ingest_combine=True")
         return connected_components_compact(
             vertex_capacity, merge=merge, compact_capacity=compact_capacity,
+            merge_mode=merge_mode,
         )
     n = vertex_capacity
     sparse = resolve_sparse_codec(codec, n)
     backend = resolve_fold_backend(fold_backend, n)
+    mode = resolve_merge_mode(merge_mode)
     # Static per-plan choice: jit specializes the fold on it, and the
     # engine's compiled-plan cache keys on agg.fold_backend.
     interp = None if backend == "xla" else not pallas_on_tpu()
@@ -679,6 +794,8 @@ def connected_components(
     def transform(s: CCSummary) -> jax.Array:
         return unionfind.component_labels(s.parent, s.seen)
 
+    _mk_delta, _mk_count = _cc_merge_delta(n)
+
     return SummaryAggregation(
         init=init,
         fold=fold,
@@ -699,6 +816,13 @@ def connected_components(
         ),
         fold_accumulates=True,  # CC forests are pure edge-set summaries
         fold_backend=backend,
+        merge_mode=mode,
+        merge_delta=_mk_delta,
+        merge_dirty_count=_mk_count,
+        # Auto threshold: delta rows cost ~8 bytes each on the wire +
+        # pair-rate union work; past capacity/4 gathered rows the full
+        # replicated merge's sequential-scan unions win.
+        merge_delta_auto_rows=n // 4,
         name=f"connected-components-{merge}",
     )
 

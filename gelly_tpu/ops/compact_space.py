@@ -32,6 +32,7 @@ per run); the heavyweight group-combine work stays parallel.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -73,6 +74,7 @@ class CompactIdSession:
         with self._turn_cv:
             self._turn = 0
             self._released = set()
+            self.wait_s = 0.0
             self._turn_cv.notify_all()
 
     def await_turn(self, seq: int) -> None:
@@ -84,9 +86,20 @@ class CompactIdSession:
         first fold referencing the cid, corrupting any window emission or
         checkpoint taken between the two. The engine numbers codec units
         from 0 per run and gates each unit's assign step here (combine
-        work stays unordered/parallel)."""
+        work stays unordered/parallel).
+
+        The blocked time accumulates into ``wait_s``: it is lock-wait, not
+        compress work, and with K concurrent workers it would otherwise be
+        booked as ``ingest_compress`` busy by the engine's stage timer —
+        inflating the "what would this cost serially" comparison the
+        overlap accounting makes (a serial run never waits here). The
+        engine reattributes it to a ``codec_wait`` stage at run teardown."""
         with self._turn_cv:
+            if self._turn >= seq:
+                return
+            t0 = time.perf_counter()
             self._turn_cv.wait_for(lambda: self._turn >= seq)
+            self.wait_s += time.perf_counter() - t0
 
     def complete_turn(self, seq: int) -> None:
         """Mark unit ``seq``'s assignment done (call in a finally: a

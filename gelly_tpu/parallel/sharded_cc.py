@@ -34,10 +34,12 @@ Folds keep the global forest consistent incrementally at pair cost (the
 replicated plans pay a full-capacity stacked union per window close,
 ``merge_forest_stack``). The only full-capacity work is EMISSION
 (``labels()``): materializing an i32[capacity] label array is inherently ∝
-capacity, so the flatten runs on the host over the pulled stripes
-(vectorized pointer jumping), and the flattened parent is pushed back so
-subsequent folds chase depth-1 state. Labels come back striped;
-:func:`~gelly_tpu.parallel.partition.unstripe` restores global slot order.
+capacity — but only the OUTPUT is. Folds mark the entries they change
+(``dirty``, newly-seen slots included), each shard compacts its dirty
+``(slot, parent)`` rows on device (``collectives.compact_delta``), and
+emission pulls ONLY those rows D2H, resolving them against host root/seen
+caches of the previous emission. The full-state pull survives as the
+dense-window fallback (when the padded buckets would outweigh it).
 """
 
 from __future__ import annotations
@@ -52,11 +54,9 @@ from ..ops.segments import INT_MAX
 from . import mesh as mesh_lib
 from .mesh import SHARD_AXIS
 from .partition import (
-    owner_of,
     repartition_by_key,
     slots_per_shard,
     to_local_slot,
-    unstripe,
 )
 
 
@@ -140,15 +140,20 @@ def _fold_pairs_body(parent_loc, seen_loc, dirty_loc, a, b, ok, num_shards,
     """One shard's view of the pair fold (runs inside shard_map)."""
     per = parent_loc.shape[0]
 
-    # Mark seen: route each endpoint to its owner once.
+    # Mark seen: route each endpoint to its owner once. Newly-seen slots
+    # are ALSO marked dirty — the incremental labels() pulls only dirty
+    # entries D2H, and a never-hooked singleton (parent untouched) must
+    # still reach the host seen cache.
     for endpoint in (a, b):
         k, _, got, _ = repartition_by_key(
             endpoint, jnp.zeros_like(endpoint), ok, num_shards,
             bucket_capacity,
         )
-        seen_loc = seen_loc.at[
+        hit = jnp.zeros((per + 1,), bool).at[
             jnp.where(got, to_local_slot(k, num_shards), per)
-        ].set(True, mode="drop")
+        ].set(True)[:per]
+        dirty_loc = dirty_loc | (hit & ~seen_loc)
+        seen_loc = seen_loc | hit
 
     def cond(st):
         _, _, live_any, _ = st
@@ -228,7 +233,59 @@ class ShardedCC:
         # at start — every slot its own root, matching the striped init).
         # labels() resolves only the DIRTY parent entries against it.
         self._rootcache = np.arange(vertex_capacity, dtype=np.int32)
+        # Host seen cache, kept current by the dirty pull (folds mark
+        # newly-seen slots dirty) — emission never pulls the full seen
+        # array off device.
+        self._seencache = np.zeros(vertex_capacity, bool)
         self._fold_fn = None
+        self._pull_fns: dict = {}
+
+        # Per-shard dirty count: sizes the delta pull's gather bucket —
+        # one tiny [S] D2H per emission instead of the full state.
+        @partial(jax.jit, out_shardings=sharded)
+        def count_dirty(dirty):
+            def body(d):
+                return jnp.sum(d[0].astype(jnp.int32))[None]
+
+            return mesh_lib.shard_map_fn(
+                self.mesh, body, in_specs=(P(SHARD_AXIS),),
+                out_specs=P(SHARD_AXIS),
+            )(dirty)
+
+        self._count_fn = count_dirty
+
+    def _pull_delta(self, bucket: int):
+        """Device-side dirty compaction (VERDICT r5: emission at 2^24 was
+        dominated by the FULL parent+seen D2H pull, 4.6s vs the 2.7s
+        fold): each shard compacts its dirty ``(global slot, parent)``
+        rows to ``bucket`` lanes and only those rows cross to the host —
+        emission transfer ∝ hooks since the last emission."""
+        fn = self._pull_fns.get(bucket)
+        if fn is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from . import collectives
+
+            sharded = NamedSharding(self.mesh, P(SHARD_AXIS))
+            S = self.S
+
+            @partial(jax.jit, out_shardings=(sharded, sharded))
+            def fn(parent, dirty):
+                def body(p, d):
+                    slots, vals, _ = collectives.compact_delta(
+                        d[0], p[0], bucket
+                    )
+                    me = jax.lax.axis_index(SHARD_AXIS)
+                    gs = jnp.where(slots >= 0, slots * S + me, -1)
+                    return gs[None], vals[None]
+
+                return mesh_lib.shard_map_fn(
+                    self.mesh, body, in_specs=(P(SHARD_AXIS),) * 2,
+                    out_specs=(P(SHARD_AXIS),) * 2,
+                )(parent, dirty)
+
+            self._pull_fns[bucket] = fn
+        return fn(self.parent, self.dirty)
 
     def _bucket(self, L: int) -> int:
         # Worst case ALL of a device's L entries route to one owner: L
@@ -325,13 +382,32 @@ class ShardedCC:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         S = self.S
-        par = np.asarray(self.parent)  # [S, per]
-        dirty = np.asarray(self.dirty)  # [S, per]
-        sg, sl = np.nonzero(dirty)  # ∝ hooks since last emission
-        g = (sl * S + sg).astype(np.int32)
+        counts = np.asarray(self._count_fn(self.dirty))  # [S], tiny D2H
+        mx = int(counts.max()) if counts.size else 0
+        bucket = max(64, 1 << max(0, mx - 1).bit_length())
+        if S * bucket * 2 >= self.n:
+            # Dense delta (first emission after a capacity-wide window,
+            # or tiny capacities): the full pull moves fewer bytes than
+            # S padded buckets would.
+            par = np.asarray(self.parent)  # [S, per]
+            dirty = np.asarray(self.dirty)  # [S, per]
+            sg, sl = np.nonzero(dirty)
+            g = (sl * S + sg).astype(np.int32)
+            pv = par[sg, sl]
+        else:
+            # Sparse delta (steady state): only the compacted dirty
+            # (slot, parent) rows cross the link — D2H ∝ hooks since the
+            # last emission, never ∝ capacity.
+            gs, vals = self._pull_delta(bucket)
+            gs = np.asarray(gs).reshape(-1)
+            pv = np.asarray(vals).reshape(-1)
+            okm = gs >= 0
+            g = gs[okm].astype(np.int32)
+            pv = pv[okm]
+        self._seencache[g] = True  # dirty ⊇ newly-seen (fold marks both)
         rc = self._rootcache
         tmp = rc.copy()
-        tmp[g] = par[sg, sl]
+        tmp[g] = pv
         if g.size:
             # Delta-chain fixpoint over the dirty entries only: chains
             # run root→newer-root, and any non-dirty target r satisfies
@@ -351,8 +427,7 @@ class ShardedCC:
                 np.zeros((S, self.per), bool),
                 NamedSharding(self.mesh, P(SHARD_AXIS)),
             )
-        seen = unstripe(np.asarray(self.seen).reshape(-1), S)
-        return np.where(seen, flat, -1).astype(np.int32)
+        return np.where(self._seencache, flat, -1).astype(np.int32)
 
     def per_device_state_bytes(self) -> int:
         return self.per * 4 + self.per  # parent i32 + seen bool

@@ -57,6 +57,50 @@ class StageTimer:
             for s in self.totals
         }
 
+    def busy(self) -> dict[str, float]:
+        """Per-stage BUSY seconds (time inside the stage's context, summed
+        across whichever threads ran it). Stages of a pipelined executor
+        overlap, so these are NOT additive along the wall clock — compare
+        them to total wall via :func:`overlap_stats`."""
+        with self._lock:
+            return {s: round(t, 6) for s, t in self.totals.items()}
+
+    def reattribute(self, src: str, dst: str, seconds: float) -> None:
+        """Move ``seconds`` of accumulated time from ``src`` to ``dst`` —
+        for lock-wait measured inside a work stage's context (overlap
+        accounting must compare wall clock to WORK, not wait). The ``dst``
+        row is booked even at 0.0 seconds so artifacts show the
+        reclassification is active, not merely absent; ``src`` clamps at
+        zero (the wait was measured independently of the stage timer, so
+        rounding can put it epsilon above the recorded total)."""
+        if seconds < 0:
+            seconds = 0.0
+        with self._lock:
+            self.totals[src] = max(0.0, self.totals[src] - seconds)
+            self.totals[dst] += seconds
+            self.counts[dst] += 1
+
+
+def overlap_stats(stage_busy: dict, total_wall: float,
+                  exclude: tuple = ("total_wall",)) -> dict:
+    """Overlap-aware pipeline accounting.
+
+    ``overlap_efficiency`` = ``total_wall / max(stage_busy)``: 1.0 means
+    the wall clock collapsed onto the single slowest stage (perfect
+    overlap); values near ``serial_stage_sum_s / max(stage_busy)`` mean
+    the stages ran back-to-back (no overlap). ``serial_stage_sum_s`` is
+    what the same work costs serially — a pipelined run should land
+    ``total_wall`` strictly below it.
+    """
+    busy = {k: float(v) for k, v in stage_busy.items() if k not in exclude}
+    mx = max(busy.values(), default=0.0)
+    return {
+        "stage_busy": {k: round(v, 4) for k, v in busy.items()},
+        "stage_busy_max_s": round(mx, 4),
+        "serial_stage_sum_s": round(sum(busy.values()), 4),
+        "overlap_efficiency": round(total_wall / mx, 3) if mx else None,
+    }
+
 
 class ThroughputMeter:
     """Running edges/sec: ``meter.record(n)`` after each batch."""

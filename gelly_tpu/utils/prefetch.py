@@ -94,7 +94,8 @@ def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
 
 
 def prefetch_map(fn, it: Iterable, depth: int = 2,
-                 workers: int = 2) -> Iterator:
+                 workers: int = 2,
+                 cancel: "threading.Event | None" = None) -> Iterator:
     """Ordered parallel map with bounded lookahead.
 
     Applies ``fn`` to up to ``depth`` upcoming items of ``it`` on a pool of
@@ -104,16 +105,32 @@ def prefetch_map(fn, it: Iterable, depth: int = 2,
     consumer's device dispatches. Falls back to a plain map when depth or
     workers is 0.
 
-    Cancellation-safe like :func:`prefetch`: abandoning the generator stops
-    the submitter thread and drains outstanding futures.
+    Cancellation-safe like :func:`prefetch`: closing/abandoning the
+    generator (break, GeneratorExit, GC) cancels the submitter thread,
+    drains the queue — so a submitter parked on a FULL queue unblocks
+    immediately instead of leaking with ``depth`` staged payloads pinned —
+    cancels the drained futures, and shuts the pool down without waiting
+    on queued work (regression:
+    ``test_prefetch_map_cancel_while_queue_full``).
+
+    ``cancel`` (optional ``threading.Event``) makes teardown reachable
+    from OUTSIDE the consuming thread: a generator can only be ``close()``d
+    between items, so when another thread is parked inside ``__next__``
+    waiting on a stalled source, nothing can deliver GeneratorExit to it.
+    Setting the event ends the stream (the parked get polls it), after
+    which the normal exit path runs. The pipelined executor sets it in its
+    teardown so abandoning the emission stream can never leave compress
+    workers consuming a stalled source in the background (regression:
+    ``test_prefetch_map_external_cancel_unblocks_parked_consumer``).
     """
     if depth <= 0 or workers <= 0:
         yield from map(fn, it)
         return
-    from concurrent.futures import ThreadPoolExecutor
+    from concurrent.futures import Future, ThreadPoolExecutor
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
-    cancel = threading.Event()
+    if cancel is None:
+        cancel = threading.Event()
     pool = ThreadPoolExecutor(max_workers=workers)
 
     def submitter():
@@ -150,15 +167,43 @@ def prefetch_map(fn, it: Iterable, depth: int = 2,
     t.start()
     try:
         while True:
-            got = q.get()
+            # Check ``cancel`` EVERY iteration, not just on an empty
+            # queue: with a fast source the queue is never empty, and an
+            # external cancel must still end the stream — the only way a
+            # thread OTHER than the one consuming this generator can end
+            # it (see the ``cancel`` doc above). The timeout-polled get
+            # bounds the wake latency while the submitter stalls.
+            if cancel.is_set():
+                return
+            try:
+                got = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
             if got is _DONE:
                 return
             if isinstance(got, _Error):
                 raise got.exc  # worker traceback preserved (see prefetch)
             yield got.result()  # re-raises fn's exception in order
     finally:
+        # Explicit close/cancel on generator exit: signal the submitter,
+        # then DRAIN the queue so a put parked on a full queue unblocks
+        # now (not after its next 0.1s poll) and the queued payloads are
+        # released; cancel drained futures so never-started work does not
+        # run against a consumer that is gone.
         cancel.set()
+        try:
+            while True:
+                got = q.get_nowait()
+                if isinstance(got, Future):
+                    got.cancel()
+        except queue.Empty:
+            pass
         pool.shutdown(wait=False, cancel_futures=True)
+        # Best-effort: the cancelled submitter exits at its next poll
+        # UNLESS it is parked inside a stalled source's __next__, which
+        # no cancel can interrupt — don't hold the consumer's teardown
+        # hostage to it (daemon thread; it dies with the process).
+        t.join(timeout=0.2)
 
 
 def restartable_prefetch(make_iter, depth: int = 2, *, start: int = 0,

@@ -1,0 +1,72 @@
+"""Subprocess body for the pipelined-executor kill -9 crash test
+(test_pipeline.py).
+
+Runs the FULL pipelined engine path — codec workers, double-buffered H2D,
+donated folds, window checkpoints via ``aggregate(checkpoint_path=...)``
+— over a deterministic stream, throttled so the kill lands with units in
+flight in the compress/H2D buffers. The second incarnation resumes
+(``resume=True`` once the checkpoint exists) and must produce final
+labels bit-identical to an uninterrupted run, proving the
+last-retired-chunk position rule: staged-but-unfolded units (including
+their stateful compact-id assignments) are re-read, never lost or
+double-folded.
+
+argv: <checkpoint_path> <out_npz> [emit_sleep_seconds]
+Env: GELLY_PIPE_EDGES / _NV / _CHUNK override the stream shape.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gelly_tpu import edge_stream_from_edges  # noqa: E402
+from gelly_tpu.engine.checkpoint import save_checkpoint  # noqa: E402
+from gelly_tpu.library.connected_components import (  # noqa: E402
+    connected_components,
+)
+
+N_EDGES = int(os.environ.get("GELLY_PIPE_EDGES", "2048"))
+N_V = int(os.environ.get("GELLY_PIPE_NV", "128"))
+CHUNK = int(os.environ.get("GELLY_PIPE_CHUNK", "32"))
+
+
+def build_stream():
+    rng = np.random.default_rng(13)
+    pairs = rng.integers(0, N_V, (N_EDGES, 2))
+    return edge_stream_from_edges(
+        [(int(a), int(b)) for a, b in pairs],
+        vertex_capacity=N_V, chunk_size=CHUNK,
+    )
+
+
+def main(argv):
+    ckpt_path, out_path = argv[0], argv[1]
+    sleep_s = float(argv[2]) if len(argv) > 2 else 0.0
+    stream = build_stream()
+    # The compact plan: stateful host cid session (the hardest resume —
+    # on_resume must rebuild it from the restored summary, dropping any
+    # in-flight staged assignments the crash stranded).
+    agg = connected_components(N_V, merge="gather", codec="compact",
+                               compact_capacity=N_V)
+    res = stream.aggregate(
+        agg, merge_every=2, fold_batch=2,
+        checkpoint_path=ckpt_path, checkpoint_every=1,
+        resume=os.path.exists(ckpt_path),
+        codec_workers=2, h2d_depth=2,
+    )
+    labels = None
+    for labels in res:
+        if sleep_s:
+            # Throttled consumer: the compress/H2D stages run ahead, so
+            # the parent's SIGKILL lands with units in flight.
+            time.sleep(sleep_s)
+    save_checkpoint(out_path, np.asarray(labels), position=res.stats["chunks"])
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -628,3 +628,156 @@ def test_finalize_survives_interpreter_shutdown():
     assert proc.returncode == 0
     assert "alive" in proc.stdout
     assert "Exception ignored" not in proc.stderr, proc.stderr
+
+
+# ---------------------------------------------------------------------- #
+# GL006 — donation use-after-free (caller-side rule)
+
+DONATION_FIXTURE = textwrap.dedent("""\
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    fold = jax.jit(lambda s, c: s + c, donate_argnums=0)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def fold2(state, x):
+        return state + x
+
+    def bad_read_after(state, x):
+        out = fold(state, x)
+        return out + state                       # read after donation
+
+    def bad_loop(state, chunks):
+        for c in chunks:
+            fold2(state, c)                      # loop without rebind
+        return 0
+
+    def bad_via_alias(state, x):
+        g = fold
+        out = g(state, x)
+        y = state + 1                            # read via alias
+        return out + y
+
+    def good_rebind_in_loop(state, chunks):
+        for c in chunks:
+            state = fold(state, c)
+        return state
+
+    def good_rebind_later_in_loop(state, chunks):
+        for c in chunks:
+            tmp = fold(state, c)
+            state = tmp                  # rebound before the back edge
+        return state
+
+    def good_loop_target_rebinds(states, x):
+        outs = []
+        for state in states:             # target binds a fresh element
+            outs.append(fold(state, x))
+        return outs
+
+    def good_drop(state, x):
+        return fold(state, x)
+
+    def good_exclusive_branches(state, x, flag):
+        if flag:
+            out = fold(state, x)
+        else:
+            out = state + 1
+        return out
+
+    def good_suppressed(state, x):
+        out = fold(state, x)
+        return out + state  # graphlint: disable=GL006
+
+    def good_deferred_closure(state, x):
+        thunk = lambda: fold(state, x)   # noqa: E731 — never runs here
+        y = state + 1                    # legitimate: nothing donated yet
+        return thunk, y
+
+    def good_closure_reads_donated(state, x):
+        out = fold(state, x)
+        thunk = lambda: state + 1        # noqa: E731 — deferred read: the
+        state = out                      # closure runs only after the rebind
+        return thunk, state
+
+    def nested_factory(x):
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(s, c):
+            return s + c
+        return step(jnp.zeros(()), x)
+
+    def good_unrelated_same_name(x, y):
+        def step(a, b):                  # plain local def: must NOT inherit
+            return a + b                 # nested_factory's donated 'step'
+        r = step(x, y)
+        return r + x
+
+    def good_local_shadows_module_donation(state, x):
+        def fold(a, b):                  # shadows the module-level donated
+            return a + b                 # 'fold' for this scope
+        out = fold(state, x)
+        return out + state
+
+    def bad_nested_donated_local_def(state, x):
+        @partial(jax.jit, donate_argnums=(0,))
+        def step3(s, c):
+            return s + c
+        out = step3(state, x)
+        return out + state               # read after local-def donation
+
+    def good_param_shadows_donated(fold, s0, x):
+        y = fold(s0, x)                  # param 'fold' is NOT the module
+        return s0 + y                    # donated fold: unknown callable
+
+    def good_plain_rebind_clears(s0, x):
+        fold2 = lambda a, b: a           # noqa: E731 — plain rebind of a
+        y = fold2(s0, x)                 # donated name: no donation here
+        return s0 + y
+
+    def good_for_target_shadows(fns, s0, x):
+        for fold in fns:                 # loop target shadows the module
+            s0 = s0 + fold(s0, x)        # donated 'fold'; reads are fine
+        return s0
+""")
+
+
+def test_jitlint_donation_use_after_free(tmp_path):
+    p = tmp_path / "donate.py"
+    p.write_text(DONATION_FIXTURE)
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    gl6 = [f for f in findings if f.rule == "GL006"]
+    assert len(gl6) == 4, "\n".join(f.render() for f in findings)
+    src_lines = DONATION_FIXTURE.splitlines()
+    flagged = {src_lines[f.line - 1].strip() for f in gl6}
+    assert "return out + state                       # read after donation" \
+        in flagged
+    assert any("fold2(state, c)" in ln for ln in flagged)
+    assert any("y = state + 1" in ln for ln in flagged)
+    assert any("read after local-def donation" in ln for ln in flagged)
+    # The safe idioms and the suppressed line produce nothing.
+    for f in findings:
+        assert "good_" not in f.message, f.render()
+
+
+def test_jitlint_donation_engine_idiom_clean(tmp_path):
+    # The engine's exact steady-state shape — donated fold rebound every
+    # iteration, window close rebuilding state — must stay clean.
+    p = tmp_path / "engine_like.py"
+    p.write_text(textwrap.dedent("""\
+        import jax
+
+        fold = jax.jit(lambda s, c: s, donate_argnums=0)
+
+        def drive(units, init):
+            state = init()
+            for u in units:
+                state = fold(state, u)
+                if u is None:
+                    emit = state
+                    state = init()
+            return state
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    assert [f for f in findings if f.rule == "GL006"] == [], \
+        "\n".join(f.render() for f in findings)

@@ -99,6 +99,33 @@ def test_session_turn_ordering():
     assert np.array_equal(s.lookup(np.array([7, 9, 8])), [0, 1, 2])
 
 
+def test_session_turn_wait_accounting():
+    # Blocked time in await_turn accumulates into session.wait_s (the
+    # engine reclassifies it out of ingest_compress busy at teardown: a
+    # serial run never waits here, so booking it as compress work would
+    # inflate the overlap accounting's serial-cost comparison). In-turn
+    # awaits must add nothing, and reset() zeroes the accumulator.
+    import threading
+    import time
+
+    s = CompactIdSession(64)
+    s.await_turn(0)  # own turn: no wait booked
+    s.complete_turn(0)
+    assert s.wait_s == 0.0
+
+    t2 = threading.Thread(target=lambda: (s.await_turn(2),
+                                          s.complete_turn(2)))
+    t2.start()
+    time.sleep(0.05)  # unit 2 parks behind unit 1
+    s.await_turn(1)
+    s.complete_turn(1)
+    t2.join(5)
+    assert not t2.is_alive()
+    assert s.wait_s >= 0.04  # the park was measured
+    s.reset()
+    assert s.wait_s == 0.0
+
+
 def test_session_turn_release_before_turn_unparks_later_units():
     # A unit that fails BEFORE its turn releases out of order; the release
     # must be remembered (not discarded) so the turn counter skips the

@@ -303,4 +303,6 @@ def test_engine_plan_cache_keys_on_fold_backend():
     agg.fold_backend = "pallas"
     agg_mod._compiled_plan(agg, m)
     assert len(agg._plan_cache) == 2
-    assert {k[-1] for k in agg._plan_cache} == {"xla", "pallas"}
+    # Key layout: (device ids, axis names, fold_backend, merge_mode).
+    assert {k[2] for k in agg._plan_cache} == {"xla", "pallas"}
+    assert {k[3] for k in agg._plan_cache} == {"auto"}

@@ -40,6 +40,26 @@ def test_stage_timer_and_meter():
     assert m.edges == 300
 
 
+def test_stage_timer_reattribute():
+    t = StageTimer()
+    t.totals["ingest_compress"] = 2.0
+    t.reattribute("ingest_compress", "codec_wait", 0.5)
+    assert t.busy() == {"ingest_compress": 1.5, "codec_wait": 0.5}
+    # Over-reattribution clamps src at zero (the wait is measured
+    # independently of the stage clock, so rounding can exceed it).
+    t.reattribute("ingest_compress", "codec_wait", 99.0)
+    b = t.busy()
+    assert b["ingest_compress"] == 0.0
+    assert b["codec_wait"] == 99.5
+    # Zero seconds still books the dst row: artifacts distinguish "no
+    # wait" from "accounting not active". Negative is treated as zero.
+    t2 = StageTimer()
+    t2.reattribute("ingest_compress", "codec_wait", 0.0)
+    t2.reattribute("ingest_compress", "codec_wait", -1.0)
+    assert t2.busy() == {"ingest_compress": 0.0, "codec_wait": 0.0}
+    assert t2.counts["codec_wait"] == 2
+
+
 def test_metered_stream_counts_valid_edges(reference_edges):
     from gelly_tpu import edge_stream_from_edges
 
@@ -232,6 +252,120 @@ def test_prefetch_cancel_while_queue_full():
         time.sleep(0.01)
     assert not (set(workers()) - before)
     assert len(pulled) < 100  # worker stopped pulling from the source
+
+
+def test_prefetch_map_cancel_while_queue_full():
+    # A consumer that stops iterating early (explicit close) while the
+    # bounded queue is FULL: the submitter must unblock from its parked
+    # put and exit, queued-but-unstarted futures must be cancelled (their
+    # fn never runs), and the worker pool must wind down — no thread
+    # parked forever holding `depth` staged payloads.
+    import threading
+    import time
+
+    from gelly_tpu.utils.prefetch import prefetch_map
+
+    def submitters():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("gelly-prefetch-submit")
+                and t.is_alive()]
+
+    before = set(submitters())
+    pulled = []
+    ran = []
+
+    def src():
+        for i in range(10_000):
+            pulled.append(i)
+            yield i
+
+    def fn(x):
+        ran.append(x)
+        return x * 2
+
+    it = prefetch_map(fn, src(), depth=2, workers=2)
+    assert next(it) == 0
+    time.sleep(0.3)  # let the submitter fill the queue and park on put
+    it.close()  # GeneratorExit -> finally -> cancel + drain + shutdown
+    deadline = time.monotonic() + 5.0
+    while (set(submitters()) - before) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not (set(submitters()) - before)  # submitter exited
+    n_after_close = len(ran)
+    time.sleep(0.3)
+    # Cancelled futures never run their fn after the close.
+    assert len(ran) == n_after_close
+    assert len(pulled) < 100  # source was not drained
+
+
+def test_prefetch_map_external_cancel_unblocks_parked_consumer():
+    # A generator can only be close()d between items, so when ANOTHER
+    # thread (the executor's H2D leg) is parked inside __next__ waiting
+    # on a stalled source, nothing can deliver GeneratorExit to it.
+    # Setting the external cancel event must end the parked get within
+    # one poll — the stream terminates, the submitter exits, and the
+    # stalled source is never pulled again.
+    import threading
+    import time
+
+    from gelly_tpu.utils.prefetch import prefetch_map
+
+    release = threading.Event()
+    cancel = threading.Event()
+    pulled = []
+
+    def src():
+        pulled.append(0)
+        yield 0
+        release.wait(10)  # a source stuck on I/O
+        for i in range(1, 100):
+            pulled.append(i)
+            yield i
+
+    it = prefetch_map(lambda x: x * 2, src(), depth=2, workers=1,
+                      cancel=cancel)
+    got = []
+
+    def consume():
+        got.extend(it)  # parks in __next__ on the stalled source
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert got == [0]  # consumer is now parked waiting for item 1
+    cancel.set()
+    t.join(2.0)
+    assert not t.is_alive()  # the parked get noticed the event
+    assert got == [0]
+    release.set()
+    time.sleep(0.3)
+    # The submitter finishes at most the one pull it was already parked
+    # on, then notices the cancel — the source is never drained.
+    assert len(pulled) <= 2
+
+
+def test_prefetch_map_external_cancel_with_fast_source():
+    # The cancel event must end the stream even when the source is FAST:
+    # the queue is then never empty, so a cancel check only on the
+    # empty-queue path would never run and the generator would keep
+    # yielding until exhaustion — the documented "setting the event ends
+    # the stream" contract requires a per-iteration check.
+    import itertools
+    import threading
+
+    from gelly_tpu.utils.prefetch import prefetch_map
+
+    cancel = threading.Event()
+    it = prefetch_map(lambda x: x, itertools.count(), depth=4, workers=1,
+                      cancel=cancel)
+    got = []
+    for v in it:
+        got.append(v)
+        if len(got) == 10:
+            cancel.set()  # same-thread set: next pull must terminate
+    assert got == list(range(10))
 
 
 def test_prefetch_map_error_while_queue_full():
