@@ -1070,6 +1070,90 @@ def tpu_cc(src, dst, num_vertices: int, chunk_size: int, merge_every: int,
     return labels, stream.ctx, dt, timer
 
 
+def obs_trace_block(src, dst, n_v: int, chunk: int, merge_every: int,
+                    fold_batch: int, codec: str, compact_capacity,
+                    off_eps: float, workload: str) -> dict:
+    """Tracer overhead + trace artifact (ISSUE 5 acceptance): re-run the
+    pipeline with an installed ``obs.SpanTracer`` — same knobs and
+    best-of-3 policy as the tracer-off headline — record tracer-on eps
+    against it, and write the best pass's validated Chrome-trace JSON
+    (Perfetto-loadable, one track per stage/worker, bus counters in
+    ``otherData``) next to bench.py as ``trace_<workload>.json``.
+
+    The overhead contract is <2% on the TPU capture; the committed CPU
+    artifact documents the schema at reduced size (CPU walls swing more
+    than 2% run to run, so ``overhead_lt_2pct`` is a v5e claim).
+    """
+    import os
+
+    from gelly_tpu import obs
+    from gelly_tpu.core.io import EdgeChunkSource
+    from gelly_tpu.core.stream import edge_stream_from_source
+    from gelly_tpu.core.vertices import IdentityVertexTable
+    from gelly_tpu.library.connected_components import connected_components
+
+    agg = connected_components(n_v, merge="gather", codec=codec,
+                               compact_capacity=compact_capacity)
+    n_e = src.shape[0]
+
+    def one_pass(tracer):
+        # Identical pass either way — same compiled plan (cached on the
+        # agg instance), same D2H completion barrier; only the installed
+        # tracer differs, so the comparison isolates tracer cost from
+        # compile/warmup variance. Each pass gets its OWN bus scope, so
+        # the snapshot exported with the trace describes exactly the
+        # traced run — never a multi-pass sum.
+        srcq = EdgeChunkSource(src, dst, chunk_size=chunk,
+                               table=IdentityVertexTable(n_v))
+        stream = edge_stream_from_source(srcq, n_v)
+        with obs.scope() as bus:
+            ctx = obs.install(tracer) if tracer is not None else None
+            t0 = time.perf_counter()
+            if ctx is None:
+                res = stream.aggregate(agg, merge_every=merge_every,
+                                       fold_batch=fold_batch)
+                np.asarray(res.result())
+            else:
+                with ctx:
+                    res = stream.aggregate(agg, merge_every=merge_every,
+                                           fold_batch=fold_batch)
+                    np.asarray(res.result())
+            dt = time.perf_counter() - t0
+            return dt, bus.snapshot()
+
+    one_pass(None)  # compile warmup outside both measurements
+    dt_off = dt_on = float("inf")
+    best = None
+    bus_snap: dict = {}
+    # Interleaved best-of-3 pairs: shared-link load swings hit both
+    # sides alike instead of biasing one.
+    for _ in range(3):
+        dt_off = min(dt_off, one_pass(None)[0])
+        tr = obs.SpanTracer(capacity=1 << 16, heartbeat_every_s=30.0)
+        t, snap = one_pass(tr)
+        if t < dt_on:
+            dt_on, best, bus_snap = t, tr, snap
+    on_eps = n_e / dt_on
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"trace_{workload}.json")
+    trace = obs.write_chrome_trace(  # validates the schema before writing
+        path, best, extra={"workload": workload, **bus_snap},
+    )
+    overhead = dt_on / dt_off - 1.0
+    return {"obs": {
+        "headline_eps": round(off_eps, 1),
+        "tracer_off_eps": round(n_e / dt_off, 1),
+        "tracer_on_eps": round(on_eps, 1),
+        "tracer_overhead_frac": round(max(0.0, overhead), 4),
+        "overhead_lt_2pct": bool(overhead < 0.02),
+        "trace_file": os.path.basename(path),
+        "trace_events": len(trace["traceEvents"]),
+        "trace_id": best.trace_id,
+        "spans_dropped": best.dropped,
+        "heartbeats": len(best.instants("heartbeat")),
+    }}
+
+
 def components_of(labels_by_id: dict) -> set[frozenset]:
     comps: dict[int, set] = {}
     for v, lbl in labels_by_id.items():
@@ -2006,6 +2090,16 @@ def bench_cc_large(args) -> dict:
         if peaks["peak_hbm_gbps"] else None
     )
 
+    # Tracer-on re-capture + Perfetto trace artifact (never kills the
+    # line: the obs block is observability OF the bench, not the bench).
+    try:
+        obs_block = obs_trace_block(
+            src, dst, n_v, chunk, merge_every, fold_batch,
+            "compact", compact_m, eps, "streaming_cc_large",
+        )
+    except Exception as e:  # noqa: BLE001
+        obs_block = {"obs": {"error": f"{type(e).__name__}: {e}"[:300]}}
+
     stages = {
         k: round(v, 4)
         for k, v in (timer.busy() if timer else {}).items()
@@ -2056,6 +2150,7 @@ def bench_cc_large(args) -> dict:
         "mem_available_gb": round(avail_gb, 2),
         "stages": stages,
         **overlap,
+        **obs_block,
     }
 
 

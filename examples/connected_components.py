@@ -2,8 +2,15 @@
 
 Usage: python examples/connected_components.py [--checkpoint-dir=DIR]
            [--codec-workers=K] [--h2d-depth=D] [--merge-mode=MODE]
-           [<edges path> <merge every chunks>]
+           [--trace-out=PATH] [<edges path> <merge every chunks>]
 Prints (vertex, component) pairs after each merge window.
+
+``--trace-out=PATH`` installs a span tracer (``gelly_tpu.obs``) around
+the run and writes a Chrome-trace JSON to PATH afterwards — open it in
+Perfetto (ui.perfetto.dev) to see per-unit produce/compress/H2D/fold
+spans, window closes, and checkpoints on one timeline (README
+"Observability"). Works with both the pipelined-executor path and the
+resilient ``--checkpoint-dir`` driver.
 
 ``--checkpoint-dir=DIR`` opts into the resilient driver
 (``gelly_tpu.engine.resilience``): the fold checkpoints into DIR every
@@ -35,6 +42,7 @@ def main(args):
     codec_workers = None
     h2d_depth = None
     merge_mode = "auto"
+    trace_out = None
     rest = []
     for a in args:
         if a.startswith("--checkpoint-dir="):
@@ -45,6 +53,8 @@ def main(args):
             h2d_depth = int(a.split("=", 1)[1])
         elif a.startswith("--merge-mode="):
             merge_mode = a.split("=", 1)[1]
+        elif a.startswith("--trace-out="):
+            trace_out = a.split("=", 1)[1]
         else:
             rest.append(a)
     if ckpt_dir is not None and (
@@ -62,15 +72,17 @@ def main(args):
     merge_every = arg(rest, 1, 4)
     agg = connected_components(stream.ctx.vertex_capacity,
                                merge_mode=merge_mode)
-    if ckpt_dir is None:
-        result = stream.aggregate(
-            agg, merge_every=merge_every,
-            codec_workers=codec_workers, h2d_depth=h2d_depth,
-        )
-        labels = None
-        for labels in result:
-            pass  # continuously-improving summaries; print the final one
-    else:
+
+    def run():
+        if ckpt_dir is None:
+            result = stream.aggregate(
+                agg, merge_every=merge_every,
+                codec_workers=codec_workers, h2d_depth=h2d_depth,
+            )
+            labels = None
+            for labels in result:
+                pass  # continuously-improving summaries; print the final
+            return labels
         # The resilient driver runs the RAW jitted fold per chunk — no
         # ingest codec / merge windows — which is correct for this dense
         # CC plan but trades the codec path's throughput for directory
@@ -94,7 +106,19 @@ def main(args):
             meta={"example": "connected_components"},
         )
         summary = runner.run()
-        labels = jax.jit(agg.transform)(summary)
+        return jax.jit(agg.transform)(summary)
+
+    if trace_out is None:
+        labels = run()
+    else:
+        from gelly_tpu import obs
+
+        tracer = obs.SpanTracer()
+        with obs.scope() as bus, obs.install(tracer):
+            labels = run()
+        trace = obs.write_chrome_trace(trace_out, tracer, bus=bus)
+        print(f"# trace: {len(trace['traceEvents'])} events -> {trace_out} "
+              f"(open in ui.perfetto.dev; trace_id={tracer.trace_id})")
     for comp in labels_to_components(labels, stream.ctx):
         print(f"{comp[0]}: {comp}")
 

@@ -30,6 +30,12 @@ and inside the local functions they call (one level deep):
   ``state = f(state, ...)`` rebinding idiom. Donation is only enforced
   on backends that implement it, so this class of bug passes CPU tests
   and crashes on TPU with "Array has been deleted".
+- ``GL007`` host clock call (``time.perf_counter``/``time.time``/
+  ``time.monotonic``/``datetime.now``/...) inside a jitted function or
+  a pallas kernel — the clock executes ONCE at trace time and its value
+  is baked into the compiled program as a constant, so the "timing"
+  silently measures nothing. Time around the jitted call on the host
+  (after ``block_until_ready``) instead.
 
 Trace-ness is tracked conservatively: the function's non-static
 parameters are traced, and locals assigned from traced expressions
@@ -97,7 +103,24 @@ RULES: dict[str, tuple[str, str]] = {
         "'Array has been deleted' at runtime (and only on backends that "
         "implement donation, so CPU tests may pass while TPU crashes)",
     ),
+    "GL007": (
+        "host clock call inside jit",
+        "host clocks execute once at TRACE time and are baked into the "
+        "compiled program as constants — the timing silently measures "
+        "nothing; time on the host around the jitted call (after "
+        "block_until_ready) or capture a profiler trace instead",
+    ),
 }
+
+# Host clock callables flagged by GL007. Keyed by how they are reached:
+# attribute calls off a `time` import, off a `datetime` import (module or
+# the datetime class — both expose .now-style constructors), or bare
+# names bound by `from time import ...`.
+_TIME_CLOCK_FNS = {
+    "time", "time_ns", "perf_counter", "perf_counter_ns", "monotonic",
+    "monotonic_ns", "process_time", "process_time_ns", "clock_gettime",
+}
+_DATETIME_CLOCK_FNS = {"now", "utcnow", "today"}
 
 # Attribute reads that are concrete (static) under tracing. `capacity`
 # is the repo convention for a shape read (EdgeChunk.capacity is
@@ -122,6 +145,37 @@ _SUPPRESS_RE = re.compile(r"#\s*graphlint:\s*disable=([A-Za-z0-9,\s]+)")
 _PALLAS_TRACED_CALLS = {"pallas_call", "load", "program_id"}
 
 
+def _scope_bound_names(fn: ast.FunctionDef) -> set:
+    """Names BOUND inside ``fn``'s own scope: parameters, assignment /
+    for / with targets, local imports, and the names of nested
+    defs/classes (whose bodies are separate scopes and bind nothing
+    here). GL007 consults this so a local that shadows a module-level
+    ``time``/``perf_counter`` import is never mistaken for the stdlib
+    clock (the same shadowing class GL006's donation lint handles)."""
+    a = fn.args
+    out = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    for v in (a.vararg, a.kwarg):
+        if v is not None:
+            out.add(v.arg)
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)
+            continue  # nested scope — do not descend
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for al in node.names:
+                out.add(al.asname or al.name.split(".")[0])
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
 def _attr_chain(node: ast.AST):
     """('jax','numpy','stack') for jax.numpy.stack; None if not a plain
     dotted name."""
@@ -144,6 +198,10 @@ class _Module:
     numpy_aliases: set
     jnp_aliases: set                 # names bound to jax.numpy
     jax_aliases: set                 # names bound to jax itself
+    time_aliases: set                # names bound to the time module
+    datetime_aliases: set            # names bound to datetime (module or
+    #   the datetime class via from-import) — both expose .now etc.
+    clock_names: set                 # bare names from `from time import …`
     pallas_aliases: set              # names bound to jax.experimental.pallas
     #   (or .tpu) — pl / pltpu under any local alias
     pallas_call_names: set           # names bound to pallas_call itself
@@ -194,7 +252,9 @@ class JitLinter:
         m = _Module(
             path=path, dotted=self._dotted_name(path), tree=tree,
             lines=src.splitlines(), numpy_aliases=set(), jnp_aliases=set(),
-            jax_aliases=set(), pallas_aliases=set(), pallas_call_names=set(),
+            jax_aliases=set(), time_aliases=set(), datetime_aliases=set(),
+            clock_names=set(),
+            pallas_aliases=set(), pallas_call_names=set(),
             jit_names=set(), module_aliases={}, from_functions={},
             functions={}, all_functions=[], jit_called={},
         )
@@ -229,6 +289,10 @@ class JitLinter:
                             m.pallas_aliases.add(alias.asname)
                     elif alias.name == "jax":
                         m.jax_aliases.add(local)
+                    elif alias.name == "time":
+                        m.time_aliases.add(local)
+                    elif alias.name == "datetime":
+                        m.datetime_aliases.add(local)
                     elif alias.name.split(".")[0] == "gelly_tpu":
                         p = self._module_path(alias.name)
                         if p:
@@ -238,6 +302,16 @@ class JitLinter:
                 self._collect_import_from(m, node)
 
     def _collect_import_from(self, m: _Module, node: ast.ImportFrom) -> None:
+        if node.level == 0 and node.module == "time":
+            for alias in node.names:
+                if alias.name in _TIME_CLOCK_FNS:
+                    m.clock_names.add(alias.asname or alias.name)
+            return
+        if node.level == 0 and node.module == "datetime":
+            for alias in node.names:
+                if alias.name == "datetime":
+                    m.datetime_aliases.add(alias.asname or "datetime")
+            return
         if node.level == 0 and node.module == "jax":
             for alias in node.names:
                 if alias.name == "numpy":
@@ -587,8 +661,10 @@ class _FunctionLint:
         self.tr = set(traced)
         self.via = via
         self.expand = expand
+        self.shadowed: set = set()
 
     def run(self, fn: ast.FunctionDef) -> None:
+        self.shadowed = _scope_bound_names(fn)
         for stmt in fn.body:
             self._stmt(stmt)
 
@@ -674,6 +750,25 @@ class _FunctionLint:
         m, via = self.m, self.via
         chain = _attr_chain(call.func)
         arg_exprs = list(call.args) + [kw.value for kw in call.keywords]
+
+        # GL007 — host clocks: flagged regardless of arguments (the call
+        # itself is the hazard; it runs once at trace time). A root name
+        # bound in THIS function's scope (parameter, local, local import)
+        # shadows the module-level clock import and is never flagged.
+        if chain and chain[0] not in self.shadowed:
+            clock = None
+            if len(chain) == 1 and chain[0] in m.clock_names:
+                clock = chain[0]
+            elif (len(chain) >= 2 and chain[0] in m.time_aliases
+                    and chain[-1] in _TIME_CLOCK_FNS):
+                clock = ".".join(chain)
+            elif (len(chain) >= 2 and chain[0] in m.datetime_aliases
+                    and chain[-1] in _DATETIME_CLOCK_FNS):
+                clock = ".".join(chain)
+            if clock is not None:
+                self.linter._emit(
+                    m, call, "GL007",
+                    f"{clock}() executes at trace time, not per step", via)
 
         if chain and chain[0] in m.numpy_aliases:
             traced = sorted(set().union(

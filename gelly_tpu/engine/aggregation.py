@@ -44,6 +44,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.chunk import EdgeChunk, split_chunk_host
+from ..obs import bus as obs_bus
+from ..obs import tracing as obs_tracing
 from ..parallel import collectives, mesh as mesh_lib, partition
 from ..parallel.mesh import SHARD_AXIS
 from . import faults as faults_mod
@@ -301,6 +303,20 @@ def bucket_stack_payloads(payloads: list, pad_values: dict,
         else:
             out[key] = np.stack([p[key] for p in payloads])
     return out
+
+
+def _payload_nbytes(payload) -> int:
+    """Host bytes of a staged unit's pytree — span attribution only
+    (called on the tracer-enabled path, never the bare unit path)."""
+    return int(sum(getattr(l, "nbytes", 0)
+                   for l in jax.tree.leaves(payload)))
+
+
+def _group_edges(group) -> int:
+    """Valid-edge count of a unit's chunk group — span/heartbeat
+    attribution only (one O(chunk) bool sum per chunk, tracer-enabled
+    path only)."""
+    return int(sum(int(np.asarray(c.valid).sum()) for c in group))
 
 
 def edges_fold_adapter(fold_edges: Callable, *, with_value: bool = True):
@@ -687,6 +703,18 @@ def run_aggregation(
     nothing, and the consumer synchronizes ONCE per merge window (the
     ``merge_emit`` block) instead of per chunk.
 
+    **Observability**: install an ``obs.SpanTracer`` (``with
+    gelly_tpu.obs.install(SpanTracer()): ...``) around the run and every
+    pipeline unit records produce → compress (worker) → H2D (buffer
+    slot) → fold spans with queue-depth and payload-size attribution;
+    window closes, checkpoints, retries and injected faults land as
+    spans/instant events; and a periodic heartbeat line reports eps,
+    queue depths and the last-retired position. Export with
+    ``obs.write_chrome_trace`` (Perfetto-loadable). Without a tracer the
+    unit path performs ZERO extra observability work. Counters
+    (units/chunks folded, windows closed, checkpoint bytes) land on
+    ``obs.get_bus()`` either way.
+
     **Exactly-once resume — the last-retired-chunk rule**: the recorded
     checkpoint position counts only chunks whose fold was *dispatched*
     (retired from the pipeline); units still in the compress/H2D double
@@ -800,6 +828,23 @@ def run_aggregation(
     def gen():
         if agg.on_run_start is not None:
             agg.on_run_start()
+        # Observability bindings, resolved ONCE per run: `tracer` is None
+        # unless an obs.SpanTracer is installed, and every span site below
+        # is guarded by that None check — the disabled unit path performs
+        # zero extra allocations (not even a clock read). The bus is
+        # always on; it is only touched at unit/window cadence.
+        tracer = obs_tracing.active_tracer()
+        bus = obs_bus.get_bus()
+        hb = None
+        meter = None
+        if tracer is not None:
+            from ..utils.metrics import ThroughputMeter
+
+            meter = ThroughputMeter()
+            if tracer.heartbeat_every_s is not None:
+                from ..obs.heartbeat import Heartbeat
+
+                hb = Heartbeat(tracer.heartbeat_every_s)
         # Ordered-wait baseline for this run (the codec session resets in
         # on_run_start, but sample rather than assume zero): the delta to
         # teardown is reclassified ingest_compress -> codec_wait.
@@ -880,17 +925,27 @@ def run_aggregation(
                 dirty = False
                 windows_closed += 1
                 stats["windows_closed"] = windows_closed
+                bus.inc("engine.windows_closed")
+                if tracer is not None:
+                    tracer.instant("window_close", window=windows_closed,
+                                   mode="accumulate")
                 return (
                     transform_fn(global_summary)
                     if transform_fn else global_summary
                 )
             merged = None
+            mode = "replicated"
             if delta_count_fn is not None:
                 # Measured per-window decision: one scalar D2H (the count)
                 # sizes the gather bucket; the delta program fuses the
                 # cross-shard merge and the Merger combine, so the close
                 # moves S * bucket dirty rows instead of S full summaries.
                 count = int(np.max(np.asarray(delta_count_fn(locals_))))
+                # The measured count IS hooks-since-last-merge — the
+                # per-window visibility the delta-merge crossover lever
+                # needs (ROADMAP: merge_delta_auto_rows is a host-side
+                # heuristic pending a measured sweep).
+                bus.gauge("engine.window_dirty_rows", count)
                 bucket = max(DELTA_MERGE_MIN_BUCKET,
                              1 << max(0, count - 1).bit_length())
                 limit = agg.merge_delta_auto_rows
@@ -899,6 +954,8 @@ def run_aggregation(
                 ):
                     merged = merge_delta_for(bucket)(locals_, global_summary)
                     stats["merge_modes"]["delta"] += 1
+                    bus.inc("engine.dirty_rows_gathered", S * bucket)
+                    mode = "delta"
             if merged is None:
                 # Replicated path (the reference Merger shape): full
                 # cross-shard merge, then combine into the global summary.
@@ -923,6 +980,10 @@ def run_aggregation(
             dirty = False
             windows_closed += 1
             stats["windows_closed"] = windows_closed
+            bus.inc("engine.windows_closed")
+            if tracer is not None:
+                tracer.instant("window_close", window=windows_closed,
+                               mode=mode)
             return transform_fn(out) if transform_fn else out
 
         def maybe_checkpoint(force=False):
@@ -939,6 +1000,7 @@ def run_aggregation(
             if not force and windows_closed - last_ckpt_windows < checkpoint_every:
                 return
             last_ckpt_windows = windows_closed
+            t_ck = tracer.now() if tracer is not None else 0.0
             if accum:
                 snap = locals_  # the running summary holds every edge
             else:
@@ -969,6 +1031,12 @@ def run_aggregation(
                     "current_window": current_window,
                 },
             )
+            ck_bytes = obs_bus.publish_checkpoint(bus, "engine",
+                                                  checkpoint_path)
+            if tracer is not None:
+                tracer.span("checkpoint", "checkpoint", t_ck,
+                            position=chunks_consumed,
+                            windows=windows_closed, bytes=ck_bytes)
             if allowed_lateness:
                 # Only after the main write is durable: stale sidecars
                 # (older positions, or the legacy unstamped name) are no
@@ -1021,6 +1089,7 @@ def run_aggregation(
             seq = 0
             group: list = []
             it = iter(stream)
+            t_unit = tracer.now() if tracer is not None else 0.0
             while True:
                 with timer("ingest_chunks"):
                     chunk = next(it, None)
@@ -1031,10 +1100,18 @@ def run_aggregation(
                     continue
                 group.append(chunk)
                 if len(group) == batch:
+                    if tracer is not None:
+                        tracer.span("produce", "produce", t_unit,
+                                    unit=seq, chunks=batch)
                     yield seq, group
                     seq += 1
                     group = []
+                    if tracer is not None:
+                        t_unit = tracer.now()
             if group:
+                if tracer is not None:
+                    tracer.span("produce", "produce", t_unit,
+                                unit=seq, chunks=len(group))
                 yield seq, group
 
         def _pad_group(group):
@@ -1061,10 +1138,26 @@ def run_aggregation(
             # builds the unit's host payload; the H2D transfer is stage 2
             # (h2d_unit, a dedicated thread), so compress of unit i+2,
             # transfer of unit i+1 and the fold of unit i all overlap.
+            # The unit's trace context is its seq: the compress span here,
+            # the H2D span (buffer slot) and the fold span all carry it,
+            # so a stalled chunk is attributable end to end.
             seq, group = unit
             try:
                 faults_mod.inject("codec")
-                return _stage_unit_inner(seq, group)
+                t0 = tracer.now() if tracer is not None else 0.0
+                payload, k = _stage_unit_inner(seq, group)
+                edges = None
+                if tracer is not None:
+                    edges = _group_edges(group)
+                    tracer.span(
+                        "compress",
+                        f"compress/{threading.current_thread().name}",
+                        t0, unit=seq, chunks=k, edges=edges,
+                        payload_bytes=_payload_nbytes(payload),
+                        queue_depth=bus.gauges.get(
+                            "pipeline.staged_depth", 0),
+                    )
+                return payload, k, seq, edges
             except BaseException:
                 # Release the unit's assignment turn so units parked
                 # behind it in await_turn unwind instead of hanging the
@@ -1129,8 +1222,9 @@ def run_aggregation(
             # block lands HERE, not on the consumer, so the recorded h2d
             # time is the real transfer and the fold dispatch never waits
             # on an in-flight upload.
-            payload, k = staged
+            payload, k, seq, edges = staged
             faults_mod.inject("h2d")
+            t0 = tracer.now() if tracer is not None else 0.0
             with timer("h2d"):
                 if use_codec:
                     if S > 1:
@@ -1148,7 +1242,16 @@ def run_aggregation(
                     )
                 else:
                     dev = payload
-            return dev, k
+            if tracer is not None:
+                # Slot attribution: which double buffer this unit landed
+                # in (seq mod depth — the rotation the prefetch leg runs).
+                slot = seq % h2d_depth if h2d_depth > 0 else 0
+                tracer.span(
+                    "h2d", f"h2d/slot{slot}", t0, unit=seq, chunks=k,
+                    slot=slot,
+                    queue_depth=bus.gauges.get("pipeline.h2d_depth", 0),
+                )
+            return dev, k, seq, edges
 
         if window_ms is not None:
             # Tumbling timestamp windows via the shared iterator
@@ -1156,69 +1259,101 @@ def run_aggregation(
             # dropped+counted (ascending-ts contract, allowedLateness=0).
             from ..core.windows import tumbling_window_events
 
-            win_seq = 0
-            for kind, w, chunk, _n in tumbling_window_events(
-                counted_chunks(), window_ms, stats,
-                initial_window=current_window,
-                allowed_lateness=allowed_lateness,
-                state_handle=lat_handle, initial_state=lat_state,
-            ):
-                if kind == "close":
-                    yield close_window()
-                elif use_codec:
-                    # The chunk is masked to window ``w``: compress it and
-                    # fold the payload — the windowed wire rides the codec
-                    # (the consumer loop is single-threaded, so stream
-                    # order is the call order). On a mesh the chunk splits
-                    # into S host slices, one payload row per device —
-                    # the same batch-axis split as merge_every staging.
-                    current_window = w
-                    with timer("ingest_compress"):
-                        if S > 1:
-                            parts = split_chunk_host(chunk, S)
-                        else:
-                            parts = [chunk]
-                        payloads = [agg.host_compress(c) for c in parts]
-                        if agg.stack_payloads is not None:
-                            if agg.stack_ordered:
-                                stacked = agg.stack_payloads(
-                                    payloads, S, seq=win_seq
-                                )
-                                win_seq += 1
+            try:
+                win_seq = 0
+                wm_unit = 0  # span unit id (window mode is consumer-serial)
+                for kind, w, chunk, _n in tumbling_window_events(
+                    counted_chunks(), window_ms, stats,
+                    initial_window=current_window,
+                    allowed_lateness=allowed_lateness,
+                    state_handle=lat_handle, initial_state=lat_state,
+                ):
+                    if kind == "close":
+                        t_merge = tracer.now() if tracer is not None else 0.0
+                        out = close_window()
+                        if tracer is not None:
+                            tracer.span("merge_emit", "merge_emit", t_merge,
+                                        window=windows_closed)
+                        yield out
+                    elif use_codec:
+                        # The chunk is masked to window ``w``: compress it and
+                        # fold the payload — the windowed wire rides the codec
+                        # (the consumer loop is single-threaded, so stream
+                        # order is the call order). On a mesh the chunk splits
+                        # into S host slices, one payload row per device —
+                        # the same batch-axis split as merge_every staging.
+                        current_window = w
+                        t0 = tracer.now() if tracer is not None else 0.0
+                        with timer("ingest_compress"):
+                            if S > 1:
+                                parts = split_chunk_host(chunk, S)
                             else:
-                                stacked = agg.stack_payloads(payloads, S)
-                        else:
-                            stacked = jax.tree.map(
-                                lambda *ls: np.stack(
-                                    [np.asarray(x) for x in ls]
-                                ),
-                                *payloads,
-                            )
-                        if S > 1:
-                            stacked = jax.tree.map(
-                                lambda x: x.reshape(
-                                    (S, x.shape[0] // S) + x.shape[1:]
-                                ),
-                                stacked,
-                            )
-                    with timer("h2d"):
-                        if S > 1:
-                            dev = mesh_lib.device_put_sharded_leading(
-                                m, stacked
-                            )
-                        else:
-                            dev = jax.device_put(stacked)
-                    with timer("fold_dispatch"):
-                        locals_ = fold_codec(locals_, dev)
-                    dirty = True
-                else:
-                    current_window = w
-                    locals_ = fold_step(locals_, chunk)
-                    dirty = True
-            # The iterator closes the final partial window itself; just make
-            # sure the last state is durably checkpointed.
-            if checkpoint_path and stats["windows_closed"]:
-                maybe_checkpoint(force=True)
+                                parts = [chunk]
+                            payloads = [agg.host_compress(c) for c in parts]
+                            if agg.stack_payloads is not None:
+                                if agg.stack_ordered:
+                                    stacked = agg.stack_payloads(
+                                        payloads, S, seq=win_seq
+                                    )
+                                    win_seq += 1
+                                else:
+                                    stacked = agg.stack_payloads(payloads, S)
+                            else:
+                                stacked = jax.tree.map(
+                                    lambda *ls: np.stack(
+                                        [np.asarray(x) for x in ls]
+                                    ),
+                                    *payloads,
+                                )
+                            if S > 1:
+                                stacked = jax.tree.map(
+                                    lambda x: x.reshape(
+                                        (S, x.shape[0] // S) + x.shape[1:]
+                                    ),
+                                    stacked,
+                                )
+                        if tracer is not None:
+                            tracer.span("compress", "compress/window", t0,
+                                        unit=wm_unit, window=int(w),
+                                        payload_bytes=_payload_nbytes(stacked))
+                            t0 = tracer.now()
+                        with timer("h2d"):
+                            if S > 1:
+                                dev = mesh_lib.device_put_sharded_leading(
+                                    m, stacked
+                                )
+                            else:
+                                dev = jax.device_put(stacked)
+                        if tracer is not None:
+                            tracer.span("h2d", "h2d/slot0", t0, unit=wm_unit,
+                                        slot=0)
+                            t0 = tracer.now()
+                        with timer("fold_dispatch"):
+                            locals_ = fold_codec(locals_, dev)
+                        if tracer is not None:
+                            tracer.span("fold", "fold", t0, unit=wm_unit,
+                                        window=int(w))
+                        wm_unit += 1
+                        dirty = True
+                    else:
+                        current_window = w
+                        t0 = tracer.now() if tracer is not None else 0.0
+                        locals_ = fold_step(locals_, chunk)
+                        if tracer is not None:
+                            tracer.span("fold", "fold", t0, unit=wm_unit,
+                                        window=int(w))
+                        wm_unit += 1
+                        dirty = True
+                # The iterator closes the final partial window itself; just make
+                # sure the last state is durably checkpointed.
+                if checkpoint_path and stats["windows_closed"]:
+                    maybe_checkpoint(force=True)
+            finally:
+                # Stage accounting lands on the registry on ANY
+                # exit — normal end, error, or the consumer
+                # abandoning the emission stream mid-window (same
+                # contract as the pipeline branch's teardown).
+                timer.publish(bus)
         else:
             chunks_consumed = skip_until
             if use_codec:
@@ -1236,39 +1371,81 @@ def run_aggregation(
             # each window close — steady-state folds neither block nor
             # allocate (state is donated).
             pipe_cancel = threading.Event()
+            # Queue-depth gauges ride the prefetch enqueue hook only when
+            # tracing (the bus write per unit is cheap, but the disabled
+            # path stays contractually untouched).
+            staged_gauge = h2d_gauge = None
+            if tracer is not None:
+                staged_gauge = lambda d: bus.gauge(  # noqa: E731
+                    "pipeline.staged_depth", d)
+                h2d_gauge = lambda d: bus.gauge(  # noqa: E731
+                    "pipeline.h2d_depth", d)
             staged = prefetch_map(
                 stage_unit, produced_units(), depth=prefetch_depth,
                 workers=ingest_workers, cancel=pipe_cancel,
+                gauge=staged_gauge,
             )
             transferred = map(h2d_unit, staged)
             if h2d_depth > 0:
-                transferred = prefetch(transferred, depth=h2d_depth)
+                transferred = prefetch(transferred, depth=h2d_depth,
+                                       gauge=h2d_gauge)
             try:
-                for unit, k in transferred:
+                for unit, k, seq, edges in transferred:
                     # Last-retired-chunk rule: a chunk counts toward the
                     # checkpoint position exactly when its fold is
                     # dispatched here; units still in the compress/H2D
                     # buffers are re-read on resume.
                     chunks_consumed += k
                     stats["chunks"] = chunks_consumed
+                    t_fold = tracer.now() if tracer is not None else 0.0
                     with timer("fold_dispatch"):
                         locals_ = fold_unit(locals_, unit)
+                    bus.inc("engine.units_folded")
+                    bus.inc("engine.chunks_folded", k)
+                    if tracer is not None:
+                        tracer.span("fold", "fold", t_fold, unit=seq,
+                                    chunks=k, edges=edges)
+                        if edges:
+                            meter.record(edges)
+                            bus.inc("engine.edges_folded", edges)
+                            meter.publish(bus, prefix="engine.throughput")
+                        if hb is not None and hb.due():
+                            # due() guards the field building: per-unit
+                            # heartbeat cost is one clock compare.
+                            hb.tick(
+                                position=chunks_consumed,
+                                eps=meter.snapshot()["edges_per_sec"],
+                                windows=windows_closed,
+                                staged_depth=bus.gauges.get(
+                                    "pipeline.staged_depth", 0),
+                                h2d_depth=bus.gauges.get(
+                                    "pipeline.h2d_depth", 0),
+                            )
                     chunks_in_window += k
                     dirty = True
                     if chunks_in_window >= merge_every:
+                        t_merge = (tracer.now() if tracer is not None
+                                   else 0.0)
                         with timer("merge_emit"):
                             out = close_window()
                             # The window's ONE completion barrier: the
                             # emission (and with it every fold of the
                             # window) is ready before it is yielded.
                             jax.block_until_ready(out)
+                        if tracer is not None:
+                            tracer.span("merge_emit", "merge_emit",
+                                        t_merge, window=windows_closed)
                         chunks_in_window = 0
                         yield out
                     maybe_checkpoint()
                 if dirty:
+                    t_merge = tracer.now() if tracer is not None else 0.0
                     with timer("merge_emit"):
                         out = close_window()
                         jax.block_until_ready(out)
+                    if tracer is not None:
+                        tracer.span("merge_emit", "merge_emit", t_merge,
+                                    window=windows_closed, final=True)
                     yield out
                     maybe_checkpoint(force=True)
             finally:
@@ -1308,6 +1485,10 @@ def run_aggregation(
                         "ingest_compress", "codec_wait",
                         agg.ordered_wait_s() - wait0,
                     )
+                # Stage accounting lands on the registry at teardown so
+                # bench/tests read busy seconds off the bus without
+                # holding the timer object.
+                timer.publish(bus)
 
     out_stream = SummaryStream(gen)
     out_stream.stats = stats

@@ -133,6 +133,15 @@ class FaultPlan:
                 self.fired.append((boundary, index, f.kind))
         if f is None:
             return
+        # Published BEFORE the fault takes effect (outside the plan lock):
+        # a hang or kill-adjacent raise still leaves the injection visible
+        # on the obs bus — and, with a tracer installed, as an instant
+        # event on the exported timeline (one per injected fault).
+        from ..obs import bus as obs_bus
+
+        obs_bus.get_bus().emit(
+            "faults.injected", boundary=boundary, index=index, kind=f.kind,
+        )
         if f.kind == "hang":
             time.sleep(f.hang_seconds)
             return

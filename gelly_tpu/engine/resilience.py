@@ -37,6 +37,13 @@ emission)`` fold contract shared by ``core/stream.py``,
 
 Every path above is *driven* in tests by the deterministic fault harness in
 ``engine/faults.py`` (``pytest -m faults``), including a kill -9 crash test.
+
+Every runtime decision above is also PUBLISHED, not just logged: retries,
+watchdog fires, degradations, source restarts, checkpoint completions /
+misses / bytes / latency all land on the process-wide ``obs`` event bus
+(``gelly_tpu.obs.get_bus()``) as counters+events — tests and bench assert
+on them programmatically, and an installed ``obs.SpanTracer`` shows each
+as an instant event on the exported timeline.
 """
 
 from __future__ import annotations
@@ -53,6 +60,7 @@ from typing import Any, Callable, Iterator
 import jax
 import numpy as np
 
+from ..obs import bus as obs_bus
 from ..utils import native as native_mod
 from ..utils.prefetch import restartable_prefetch
 from . import faults as faults_mod
@@ -158,6 +166,12 @@ class Watchdog:
         )
         t.start()
         if not done.wait(self.timeout):
+            # Observable, not just raised: tests/bench read the fire
+            # count off the bus; an installed tracer gets the instant.
+            obs_bus.get_bus().emit(
+                "resilience.watchdog_timeouts", boundary=boundary,
+                timeout_s=self.timeout,
+            )
             raise WatchdogTimeout(boundary, self.timeout)
         kind, payload = box[0]
         if kind == "err":
@@ -248,6 +262,7 @@ class CheckpointManager:
     def _write_inner(self, host, position: int, meta: dict | None) -> None:
         path = self.path_for(position)
         attempt = 0
+        t0 = time.perf_counter()
         while True:
             try:
                 faults_mod.inject("checkpoint_write", path=path)
@@ -262,6 +277,10 @@ class CheckpointManager:
                         "checkpoint_write", attempt, e
                     ) from e
                 time.sleep(self.retry.delay(attempt - 1, self._rng))
+        # Durability currency on the bus: bytes written and write latency
+        # are what the checkpoint cadence trades against fold throughput.
+        obs_bus.publish_checkpoint(obs_bus.get_bus(), "resilience", path,
+                                   t0=t0)
         # Torn-write simulation point: fires AFTER the file is durable so a
         # corrupt fault produces exactly the artifact load must survive.
         faults_mod.inject("checkpoint_corrupt", path=path)
@@ -469,6 +488,11 @@ class ResilientRunner:
                 if attempt >= policy.max_attempts:
                     raise RetriesExhausted(boundary, attempt, e) from e
                 self.stats["retries"] += 1
+                obs_bus.get_bus().emit(
+                    "resilience.retries", boundary=boundary,
+                    attempt=attempt,
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
                 delay = policy.delay(attempt - 1, self._rng)
                 logger.warning(
                     "boundary '%s' attempt %d/%d failed (%s: %s); "
@@ -500,6 +524,11 @@ class ResilientRunner:
         self._step = self._fallback_step
         self._degraded = True
         self.stats["degraded"] = True
+        obs_bus.get_bus().emit(
+            "resilience.degradations", stem=stem or "",
+            failures=self._native_failures,
+            error=f"{type(exc).__name__}: {exc}"[:200],
+        )
         return True
 
     # ------------------------------------------------------------------ #
@@ -536,6 +565,10 @@ class ResilientRunner:
             ok = default_retryable(exc)
             if ok:
                 self.stats["restarts"] += 1
+                obs_bus.get_bus().emit(
+                    "resilience.source_restarts", position=self.position,
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
                 logger.warning(
                     "chunk source failed (%s: %s); restarting at chunk %d",
                     type(exc).__name__, exc, self.position,
@@ -610,6 +643,10 @@ class ResilientRunner:
         except (WatchdogTimeout, RetriesExhausted):
             self.stats["checkpoint_failures"] += 1
             consecutive = self.manager.consecutive_failures
+            obs_bus.get_bus().emit(
+                "resilience.checkpoint_misses", position=self.position,
+                consecutive=consecutive, final=final,
+            )
             if final or consecutive >= self.config.max_checkpoint_failures:
                 raise
             logger.error(

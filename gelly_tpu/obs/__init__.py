@@ -1,0 +1,53 @@
+"""Observability runtime: span tracing, event bus, trace export.
+
+The reference delegates ALL of this to Flink's runtime (web UI, metrics
+registry, checkpoint stats — SURVEY.md §5: the repo's sole in-tree
+instrument is a ``getNetRuntime()`` printout). The TPU-native framework
+re-owns it:
+
+- :mod:`~gelly_tpu.obs.bus` — a process-wide :class:`EventBus` of
+  counters, gauges and structured events. Runtime modules
+  (``engine/resilience.py``, ``engine/faults.py``, the pipelined
+  executor, ``parallel/sharded_cc.py``) publish here instead of
+  log-text-only, so tests and bench assert on runtime behavior
+  programmatically (``get_bus().counters[...]``) rather than grepping
+  logs.
+- :mod:`~gelly_tpu.obs.tracing` — a low-overhead per-unit
+  :class:`SpanTracer`: every pipeline unit carries its id through
+  produce → compress (worker K) → H2D (buffer slot) → fold →
+  merge-window close → checkpoint, each span recording thread/worker,
+  queue depth and payload sizes into a bounded ring buffer. Disabled
+  (the default) the unit path performs ZERO extra allocations — every
+  call site is guarded by a plain ``tracer is not None`` check on a
+  generator-local binding.
+- :mod:`~gelly_tpu.obs.export` — Chrome-trace-event JSON
+  (Perfetto-loadable): one track per stage/worker, instant events for
+  retries/faults/window closes, and the tracer's ``trace_id`` in
+  ``otherData`` so a device-side ``jax.profiler`` trace captured around
+  the same run (``utils.metrics.trace(log_dir, tracer=...)``) can be
+  laid alongside it.
+- :mod:`~gelly_tpu.obs.heartbeat` — a periodic progress line (eps,
+  queue depths, last-retired position) for long streams.
+"""
+
+from .bus import EventBus, get_bus, scope
+from .export import (
+    to_chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+from .heartbeat import Heartbeat
+from .tracing import SpanTracer, active_tracer, install
+
+__all__ = [
+    "EventBus",
+    "get_bus",
+    "scope",
+    "SpanTracer",
+    "active_tracer",
+    "install",
+    "to_chrome_trace",
+    "validate_chrome_trace",
+    "write_chrome_trace",
+    "Heartbeat",
+]

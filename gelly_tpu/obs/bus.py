@@ -1,0 +1,164 @@
+"""Process-wide event bus: counters, gauges, structured events.
+
+One default :class:`EventBus` exists per process (:func:`get_bus`) so
+runtime modules can publish without any wiring — the same stance as the
+fault registry in ``engine/faults.py``. Publishing is a locked dict
+update (no I/O, no allocation beyond the event dict for :meth:`emit`),
+cheap enough to stay always-on at the cadences the runtime publishes at
+(per retry, per window close, per checkpoint — never per edge).
+
+Counter/gauge names are dotted, ``<subsystem>.<what>``:
+
+====================================  =================================
+``resilience.retries``                guarded-boundary retries
+``resilience.watchdog_timeouts``      watchdog fires (hung calls)
+``resilience.degradations``           native→fallback ladder trips
+``resilience.source_restarts``        chunk-source reopenings
+``resilience.checkpoints``            completed checkpoint writes
+``resilience.checkpoint_misses``      tolerated mid-stream ckpt failures
+``resilience.checkpoint_bytes``       cumulative checkpoint file bytes
+``resilience.checkpoint_write_s``     last write latency (gauge)
+``faults.injected``                   FaultPlan faults that fired
+``engine.units_folded``               pipeline units retired by a fold
+``engine.chunks_folded``              chunks inside those units
+``engine.edges_folded``               valid edges (tracer-enabled runs)
+``engine.windows_closed``             merge windows closed
+``engine.window_dirty_rows``          dirty count at last delta close
+``engine.checkpoint_bytes``           aggregate-path checkpoint bytes
+``pipeline.staged_depth``             compress→H2D queue depth (gauge)
+``pipeline.h2d_depth``                H2D→fold queue depth (gauge)
+``sharded_cc.window_dirty_rows``      dirty entries at last emission
+``sharded_cc.dirty_rows_gathered``    dirty rows pulled D2H, cumulative
+====================================  =================================
+
+Tests that need isolation wrap the block in :func:`scope`, which swaps
+a fresh bus in for the dynamic extent — publishers always resolve the
+bus at call time (``get_bus()``), so the swap is complete.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable, Iterator
+
+import contextlib
+
+
+class EventBus:
+    """Thread-safe counters + gauges + subscriber fan-out.
+
+    - :meth:`inc` — add to a (float-valued) counter;
+    - :meth:`gauge` — set a last-value gauge;
+    - :meth:`emit` — publish a structured event: bumps the
+      ``<name>`` counter, forwards the event dict to subscribers, and
+      records an instant event into the active span tracer (if one is
+      installed) so exported traces show retries/faults/degradations on
+      the timeline.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self._subs: list[Callable[[str, dict], None]] = []
+
+    def inc(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    def emit(self, name: str, **fields) -> None:
+        with self._lock:
+            self.counters[name] += 1
+            subs = list(self._subs)
+        for fn in subs:
+            try:
+                fn(name, fields)
+            except Exception:  # noqa: BLE001
+                # A raising subscriber must never turn observability into
+                # a runtime fault at the PUBLISHER's call site (the
+                # watchdog/retry/fault-injection paths all emit).
+                import logging
+
+                logging.getLogger("gelly_tpu.obs").exception(
+                    "event-bus subscriber failed on %r", name)
+        # Mirror onto the trace timeline. Imported lazily (bus must stay
+        # importable first — tracing imports nothing back from here).
+        from .tracing import active_tracer
+
+        tr = active_tracer()
+        if tr is not None:
+            tr.instant(name, **fields)
+
+    def subscribe(self, fn: Callable[[str, dict], None]) -> Callable[[], None]:
+        """Register ``fn(name, fields)`` for every :meth:`emit`; returns
+        an unsubscribe callable."""
+        with self._lock:
+            self._subs.append(fn)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if fn in self._subs:
+                    self._subs.remove(fn)
+
+        return unsubscribe
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy ``{"counters": {...}, "gauges": {...}}``."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+            }
+
+
+def publish_checkpoint(bus: EventBus, prefix: str, path: str,
+                       t0: float | None = None) -> int:
+    """Shared checkpoint-durability publishing (used by BOTH checkpoint
+    writers — ``engine/resilience.CheckpointManager`` and the aggregate
+    path's ``maybe_checkpoint``): bump ``<prefix>.checkpoints`` and
+    ``<prefix>.checkpoint_bytes`` (file size; 0 when unreadable), and
+    when ``t0`` (``time.perf_counter()`` at write start) is given, gauge
+    ``<prefix>.checkpoint_write_s``. Returns the byte count."""
+    import os
+    import time
+
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = 0
+    bus.inc(f"{prefix}.checkpoints")
+    bus.inc(f"{prefix}.checkpoint_bytes", size)
+    if t0 is not None:
+        bus.gauge(f"{prefix}.checkpoint_write_s",
+                  round(time.perf_counter() - t0, 6))
+    return size
+
+
+_DEFAULT = EventBus()
+_CURRENT: EventBus = _DEFAULT
+_SWAP_LOCK = threading.Lock()
+
+
+def get_bus() -> EventBus:
+    """The process-wide bus (or the innermost :func:`scope` bus)."""
+    return _CURRENT
+
+
+@contextlib.contextmanager
+def scope(bus: EventBus | None = None) -> Iterator[EventBus]:
+    """Swap a fresh (or given) bus in for the dynamic extent — test
+    isolation without publishers needing to thread a bus parameter."""
+    global _CURRENT
+    new = bus if bus is not None else EventBus()
+    with _SWAP_LOCK:
+        prev, _CURRENT = _CURRENT, new
+    try:
+        yield new
+    finally:
+        with _SWAP_LOCK:
+            _CURRENT = prev

@@ -1,0 +1,146 @@
+"""Chrome-trace-event JSON export (Perfetto-loadable).
+
+The exported object is the Chrome Trace Event format's "JSON Object
+Format" (the one Perfetto, ``chrome://tracing`` and ``ui.perfetto.dev``
+all load):
+
+    {"traceEvents": [...], "displayTimeUnit": "ms",
+     "otherData": {"trace_id": ..., "counters": ..., "gauges": ...}}
+
+Tracks: every distinct ``track`` string the tracer recorded (one per
+stage/worker — ``compress/w140233…``, ``h2d/slot0``, ``fold``,
+``merge_emit``, ``checkpoint``, ``events``) becomes one ``tid`` inside
+``pid`` 1, named via ``"M"``-phase ``thread_name`` metadata events so
+the viewer shows lanes by stage, not by raw thread id. Span timestamps
+are converted from the tracer's seconds to the microseconds the format
+requires; instant events carry ``"s": "g"`` (global scope) so they draw
+as full-height markers.
+
+Alignment with a device-side ``jax.profiler`` trace: both carry the
+tracer's ``trace_id`` (``otherData.trace_id`` here; the profiler trace
+directory is recorded under ``otherData.jax_profiler`` when
+``utils.metrics.trace(log_dir, tracer=...)`` ran around the same run),
+so the two timelines can be opened side by side and matched.
+
+:func:`validate_chrome_trace` is the schema check the tests and the
+bench artifact path share — load-bearing validation, not a smoke print.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from .tracing import SpanTracer
+
+_US = 1e6  # tracer seconds -> trace-event microseconds
+
+PID = 1
+
+
+def to_chrome_trace(tracer: SpanTracer, bus=None,
+                    extra: dict | None = None) -> dict:
+    """Render ``tracer``'s ring (and optionally a bus snapshot) to a
+    Chrome-trace dict. ``extra`` merges into ``otherData``."""
+    records = tracer.records()
+    # Stable track -> tid assignment in first-seen order.
+    tids: dict[str, int] = {}
+    events: list[dict] = [{
+        "ph": "M", "name": "process_name", "pid": PID, "tid": 0,
+        "args": {"name": f"gelly_tpu:{tracer.trace_id}"},
+    }]
+    for r in records:
+        track = r["track"]
+        if track not in tids:
+            tids[track] = len(tids) + 1
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": PID,
+                "tid": tids[track], "args": {"name": track},
+            })
+    for r in records:
+        ev: dict[str, Any] = {
+            "name": r["name"], "ph": r["ph"], "cat": "gelly",
+            "ts": round(r["ts"] * _US, 3),
+            "pid": PID, "tid": tids[r["track"]],
+            "args": dict(r["args"], thread=r["thread"]),
+        }
+        if r["ph"] == "X":
+            ev["dur"] = round(r["dur"] * _US, 3)
+        elif r["ph"] == "i":
+            ev["s"] = "g"
+        events.append(ev)
+    other = {
+        "trace_id": tracer.trace_id,
+        "span_capacity": tracer.capacity,
+        "spans_dropped": tracer.dropped,
+    }
+    if bus is not None:
+        other.update(bus.snapshot())
+    if extra:
+        other.update(extra)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": other,
+    }
+
+
+def write_chrome_trace(path: str, tracer: SpanTracer, bus=None,
+                       extra: dict | None = None) -> dict:
+    """Validate + write the trace to ``path``; returns the trace dict."""
+    trace = to_chrome_trace(tracer, bus=bus, extra=extra)
+    validate_chrome_trace(trace)
+    with open(path, "w") as f:
+        json.dump(trace, f, indent=1)
+        f.write("\n")
+    return trace
+
+
+def validate_chrome_trace(trace: dict) -> None:
+    """Raise ``ValueError`` unless ``trace`` is well-formed Chrome-trace
+    JSON (object format): JSON-serializable, ``traceEvents`` a list of
+    events each carrying ``name``/``ph``/``pid``/``tid``, numeric ``ts``
+    on non-metadata phases, numeric non-negative ``dur`` on ``"X"``
+    spans, and every referenced ``tid`` named by a ``thread_name``
+    metadata event."""
+    if not isinstance(trace, dict):
+        raise ValueError(f"trace must be a dict, got {type(trace).__name__}")
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("trace['traceEvents'] must be a list")
+    try:
+        json.dumps(trace)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"trace is not JSON-serializable: {e}") from e
+    named_tids = set()
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event #{i} is not a dict")
+        for key in ("name", "ph", "pid", "tid"):
+            if key not in ev:
+                raise ValueError(f"event #{i} ({ev.get('name')}) lacks "
+                                 f"required key {key!r}")
+        ph = ev["ph"]
+        if ph == "M":
+            if ev["name"] == "thread_name":
+                named_tids.add(ev["tid"])
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
+            raise ValueError(f"event #{i} ({ev['name']}): ts must be numeric")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(
+                    f"event #{i} ({ev['name']}): 'X' span needs numeric "
+                    f"dur >= 0, got {dur!r}")
+        elif ph == "i":
+            if ev.get("s") not in ("g", "p", "t"):
+                raise ValueError(
+                    f"event #{i} ({ev['name']}): instant needs scope "
+                    "'s' in g/p/t")
+        else:
+            raise ValueError(f"event #{i}: unexpected phase {ph!r}")
+        if ev["tid"] != 0 and ev["tid"] not in named_tids:
+            raise ValueError(
+                f"event #{i} ({ev['name']}): tid {ev['tid']} has no "
+                "thread_name metadata (track unnamed in the viewer)")

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import bus as obs_bus
 from ..ops.segments import INT_MAX
 from . import mesh as mesh_lib
 from .mesh import SHARD_AXIS
@@ -384,6 +385,13 @@ class ShardedCC:
         S = self.S
         counts = np.asarray(self._count_fn(self.dirty))  # [S], tiny D2H
         mx = int(counts.max()) if counts.size else 0
+        # Per-window dirty-row gauges (ISSUE 5): the emission-cost
+        # currency of this plan — labels() moves dirty rows, not
+        # capacity — made visible per window close instead of inferable
+        # only from wall clock.
+        bus = obs_bus.get_bus()
+        bus.gauge("sharded_cc.window_dirty_rows", int(counts.sum()))
+        bus.gauge("sharded_cc.window_dirty_max_shard", mx)
         bucket = max(64, 1 << max(0, mx - 1).bit_length())
         if S * bucket * 2 >= self.n:
             # Dense delta (first emission after a capacity-wide window,
@@ -394,6 +402,7 @@ class ShardedCC:
             sg, sl = np.nonzero(dirty)
             g = (sl * S + sg).astype(np.int32)
             pv = par[sg, sl]
+            bus.inc("sharded_cc.emissions_dense")
         else:
             # Sparse delta (steady state): only the compacted dirty
             # (slot, parent) rows cross the link — D2H ∝ hooks since the
@@ -404,6 +413,8 @@ class ShardedCC:
             okm = gs >= 0
             g = gs[okm].astype(np.int32)
             pv = pv[okm]
+            bus.inc("sharded_cc.emissions_sparse")
+        bus.inc("sharded_cc.dirty_rows_gathered", int(g.size))
         self._seencache[g] = True  # dirty ⊇ newly-seen (fold marks both)
         rc = self._rootcache
         tmp = rc.copy()

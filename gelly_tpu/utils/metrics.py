@@ -65,6 +65,14 @@ class StageTimer:
         with self._lock:
             return {s: round(t, 6) for s, t in self.totals.items()}
 
+    def publish(self, bus, prefix: str = "stage") -> None:
+        """Feed the per-stage busy seconds into an ``obs`` registry as
+        gauges (``<prefix>.<stage>.busy_s``) — the pipelined executor
+        calls this at teardown so bench/tests read stage accounting off
+        the bus instead of holding the timer object."""
+        for s, t in self.busy().items():
+            bus.gauge(f"{prefix}.{s}.busy_s", t)
+
     def reattribute(self, src: str, dst: str, seconds: float) -> None:
         """Move ``seconds`` of accumulated time from ``src`` to ``dst`` —
         for lock-wait measured inside a work stage's context (overlap
@@ -109,6 +117,9 @@ class ThroughputMeter:
         self.edges = 0
         self.start = None
         self.last = None
+        # Construction time: the elapsed fallback for a single-sample
+        # meter (first-sample time alone spans no interval).
+        self._created = time.perf_counter()
 
     def record(self, n: int):
         now = time.perf_counter()
@@ -119,13 +130,33 @@ class ThroughputMeter:
 
     @property
     def elapsed(self) -> float:
-        if self.start is None:
+        if self.last is None:
             return 0.0
-        return (self.last or self.start) - self.start
+        span = self.last - self.start
+        if span > 0:
+            return span
+        # A single record() leaves start == last, which read as
+        # elapsed == 0 and an edges/sec of 0.0 despite nonzero edges
+        # (ISSUE 5 satellite): fall back to time since the meter was
+        # created — the interval the one sample actually covers.
+        return self.last - self._created
 
     @property
     def edges_per_sec(self) -> float:
         return self.edges / self.elapsed if self.elapsed > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        """Point-in-time reading for heartbeats / bench lines."""
+        return {
+            "edges": self.edges,
+            "elapsed_s": round(self.elapsed, 6),
+            "edges_per_sec": round(self.edges_per_sec, 1),
+        }
+
+    def publish(self, bus, prefix: str = "throughput") -> None:
+        """Feed the current reading into an ``obs`` registry as gauges."""
+        bus.gauge(f"{prefix}.edges", self.edges)
+        bus.gauge(f"{prefix}.edges_per_sec", round(self.edges_per_sec, 1))
 
 
 def metered(chunks: Iterable, meter: ThroughputMeter) -> Iterator:
@@ -136,15 +167,48 @@ def metered(chunks: Iterable, meter: ThroughputMeter) -> Iterator:
 
 
 @contextlib.contextmanager
-def trace(log_dir: str | None):
-    """Device-level profiling via jax.profiler; no-op when log_dir is None."""
+def trace(log_dir: str | None, tracer=None):
+    """Device-level profiling via jax.profiler; no-op when log_dir is None.
+
+    Exception-safe (ISSUE 5 satellite): a body that raises can no longer
+    leave a dangling started trace — ``stop_trace`` always runs, and a
+    failing stop is logged rather than allowed to MASK the body's
+    exception. When ``jax.profiler`` is unavailable on the platform (or
+    the start itself fails — e.g. a trace is already running), the block
+    degrades to a clean no-op: observability must never kill the
+    measured run.
+
+    ``tracer`` (an ``obs.SpanTracer``) records start/stop instant events
+    carrying its shared ``trace_id``, so the exported span trace and the
+    device-side profiler trace captured around the same run can be
+    aligned in Perfetto.
+    """
     if log_dir is None:
         yield
         return
-    import jax
+    import logging
 
-    jax.profiler.start_trace(log_dir)
+    log = logging.getLogger("gelly_tpu.obs")
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # noqa: BLE001 — profiler absent/busy: no-op
+        log.warning("jax.profiler trace unavailable (%s: %s); running "
+                    "untraced", type(e).__name__, e)
+        yield
+        return
+    if tracer is not None:
+        tracer.instant("jax_profiler_start", log_dir=log_dir,
+                       trace_id=tracer.trace_id)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            # Never mask the body's exception with a failed stop.
+            log.warning("jax.profiler stop_trace failed (%s: %s)",
+                        type(e).__name__, e)
+        if tracer is not None:
+            tracer.instant("jax_profiler_stop", log_dir=log_dir)

@@ -27,12 +27,18 @@ class _Error:
         self.exc = exc
 
 
-def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
+def prefetch(it: Iterable[T], depth: int = 2,
+             gauge=None) -> Iterator[T]:
     """Iterate ``it`` on a background thread, ``depth`` items ahead.
 
     Cancellation-safe: abandoning the returned generator (break /
     GeneratorExit / GC) signals the worker, which stops pulling from the
     source and exits instead of blocking forever on the full queue.
+
+    ``gauge`` (optional ``callable(int)``) samples the queue depth at
+    each successful enqueue — the observability hook the pipelined
+    executor wires to an ``obs`` bus gauge so span traces can record
+    queue-depth-at-enqueue. None (the default) costs nothing.
     """
     if depth <= 0:
         yield from it
@@ -46,6 +52,8 @@ def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
                 while not cancel.is_set():
                     try:
                         q.put(item, timeout=0.1)
+                        if gauge is not None:
+                            gauge(q.qsize())
                         break
                     except queue.Full:
                         continue
@@ -95,7 +103,8 @@ def prefetch(it: Iterable[T], depth: int = 2) -> Iterator[T]:
 
 def prefetch_map(fn, it: Iterable, depth: int = 2,
                  workers: int = 2,
-                 cancel: "threading.Event | None" = None) -> Iterator:
+                 cancel: "threading.Event | None" = None,
+                 gauge=None) -> Iterator:
     """Ordered parallel map with bounded lookahead.
 
     Applies ``fn`` to up to ``depth`` upcoming items of ``it`` on a pool of
@@ -122,6 +131,10 @@ def prefetch_map(fn, it: Iterable, depth: int = 2,
     teardown so abandoning the emission stream can never leave compress
     workers consuming a stalled source in the background (regression:
     ``test_prefetch_map_external_cancel_unblocks_parked_consumer``).
+
+    ``gauge`` — same queue-depth-at-enqueue sampling hook as
+    :func:`prefetch` (called with ``qsize`` after each submitted item
+    lands in the bounded queue); None costs nothing.
     """
     if depth <= 0 or workers <= 0:
         yield from map(fn, it)
@@ -131,7 +144,11 @@ def prefetch_map(fn, it: Iterable, depth: int = 2,
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     if cancel is None:
         cancel = threading.Event()
-    pool = ThreadPoolExecutor(max_workers=workers)
+    # Named workers: span traces use the thread name as the per-worker
+    # track ("compress/gelly-codec_0"), so the pool must not present as
+    # an anonymous ThreadPoolExecutor-<n>.
+    pool = ThreadPoolExecutor(max_workers=workers,
+                              thread_name_prefix="gelly-codec")
 
     def submitter():
         try:
@@ -140,6 +157,8 @@ def prefetch_map(fn, it: Iterable, depth: int = 2,
                 while not cancel.is_set():
                     try:
                         q.put(fut, timeout=0.1)
+                        if gauge is not None:
+                            gauge(q.qsize())
                         break
                     except queue.Full:
                         continue

@@ -453,6 +453,102 @@ def test_jitlint_cli_nonzero_on_fixture(lint_fixture):
         assert rule in proc.stdout, rule
 
 
+def test_jitlint_host_clock_in_jit_flagged(tmp_path):
+    # GL007: host clocks inside a jitted function execute once at trace
+    # time — every import spelling is caught, and the suppression
+    # comment is honored.
+    p = tmp_path / "clock.py"
+    p.write_text(textwrap.dedent("""\
+        import time
+        import time as _t
+        import datetime as dt
+        from time import perf_counter
+        from datetime import datetime
+        import jax
+
+        @jax.jit
+        def f(x):
+            a = time.perf_counter()
+            b = _t.monotonic()
+            c = dt.datetime.now()
+            d = datetime.utcnow()
+            e = perf_counter()
+            s = time.time()  # graphlint: disable=GL007
+            return x + a + b + e
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    assert all(f.rule == "GL007" for f in findings)
+    hits = {f.message.split("(")[0].split(":")[1].strip() for f in findings}
+    assert hits == {"time.perf_counter", "_t.monotonic",
+                    "dt.datetime.now", "datetime.utcnow", "perf_counter"}
+    # the suppressed time.time() line produced no finding
+    assert not any("time.time" in f.message for f in findings)
+
+
+def test_jitlint_host_clock_shadowed_locals_not_flagged(tmp_path):
+    # A parameter or local that SHADOWS a module-level time/perf_counter
+    # import is an unrelated callable, not the stdlib clock — same
+    # scoping discipline as GL006's donation bindings.
+    p = tmp_path / "shadow.py"
+    p.write_text(textwrap.dedent("""\
+        import time
+        from time import perf_counter
+        import jax
+
+        @jax.jit
+        def param_shadows(x, perf_counter):
+            return x + perf_counter(x)
+
+        @jax.jit
+        def local_shadows(x):
+            time = make_table()
+            return x + time.time()
+
+        @jax.jit
+        def still_flagged(x):
+            return x + time.perf_counter()
+
+        def make_table():
+            return None
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert findings[0].rule == "GL007"
+    assert "still_flagged" in findings[0].message
+
+
+def test_jitlint_host_clock_outside_jit_and_kernels(tmp_path):
+    # Both ways: the same clock calls in a HOST function are legitimate
+    # timing code and must not be flagged; inside a pallas kernel they
+    # ARE flagged (kernels always compile).
+    p = tmp_path / "clock2.py"
+    p.write_text(textwrap.dedent("""\
+        import time
+        import jax
+        from jax.experimental import pallas as pl
+
+        def host_timing(fn):
+            t0 = time.perf_counter()
+            out = fn()
+            return out, time.perf_counter() - t0
+
+        def kernel(x_ref, o_ref):
+            t = time.time()
+            o_ref[...] = x_ref[...]
+
+        def build(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+    """))
+    findings = jitlint.lint_paths(str(tmp_path), [str(p)])
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert findings[0].rule == "GL007"
+    assert "pallas kernel" in findings[0].message
+    assert "time.time" in findings[0].message
+
+
 # --------------------------------------------------------------------- #
 # sanitizer lane
 

@@ -1,0 +1,395 @@
+"""Observability runtime: event bus, span tracer, Chrome-trace export.
+
+The acceptance bar (ISSUE 5): an exported trace from a reduced capture is
+VALID Chrome-trace JSON with >= 1 span per pipeline stage per unit and
+worker/slot attribution; every injected fault of a seeded FaultPlan shows
+as an instant event; runtime behavior (retries, faults, windows) is
+assertable off the event bus, not log text.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gelly_tpu import edge_stream_from_edges, obs
+from gelly_tpu.engine import faults
+from gelly_tpu.library.connected_components import (
+    connected_components,
+    labels_to_components,
+)
+
+EDGES = [(1, 2), (2, 3), (4, 5), (1, 3), (5, 6), (7, 8), (2, 4), (6, 9)]
+EXPECTED = [[1, 2, 3, 4, 5, 6, 9], [7, 8]]
+
+
+def _run_cc(tracer=None, chunk_size=2, merge_every=2, **agg_kw):
+    s = edge_stream_from_edges(EDGES, vertex_capacity=32,
+                               chunk_size=chunk_size)
+    agg = connected_components(32)
+    if tracer is None:
+        labels = s.aggregate(agg, merge_every=merge_every, **agg_kw).result()
+    else:
+        with obs.install(tracer):
+            labels = s.aggregate(agg, merge_every=merge_every,
+                                 **agg_kw).result()
+    assert labels_to_components(labels, s.ctx) == EXPECTED
+    return labels
+
+
+# --------------------------------------------------------------------- #
+# event bus
+
+
+def test_bus_counters_gauges_and_snapshot():
+    bus = obs.EventBus()
+    bus.inc("a.count")
+    bus.inc("a.count", 2.5)
+    bus.gauge("a.depth", 7)
+    snap = bus.snapshot()
+    assert snap["counters"]["a.count"] == 3.5
+    assert snap["gauges"]["a.depth"] == 7
+    # snapshot is a copy, not a view
+    bus.inc("a.count")
+    assert snap["counters"]["a.count"] == 3.5
+
+
+def test_bus_emit_counts_notifies_and_traces():
+    bus = obs.EventBus()
+    seen = []
+    unsub = bus.subscribe(lambda name, fields: seen.append((name, fields)))
+    tr = obs.SpanTracer()
+    with obs.install(tr):
+        bus.emit("x.fired", boundary="h2d", index=3)
+    unsub()
+    bus.emit("x.fired", boundary="h2d", index=4)  # after unsubscribe
+    assert bus.snapshot()["counters"]["x.fired"] == 2
+    assert seen == [("x.fired", {"boundary": "h2d", "index": 3})]
+    inst = tr.instants("x.fired")
+    assert len(inst) == 1 and inst[0]["args"]["index"] == 3
+
+
+def test_bus_scope_isolates_and_restores():
+    outer = obs.get_bus()
+    outer_count = outer.snapshot()["counters"].get("scoped.c", 0)
+    with obs.scope() as inner:
+        assert obs.get_bus() is inner
+        obs.get_bus().inc("scoped.c")
+        assert inner.snapshot()["counters"]["scoped.c"] == 1
+    assert obs.get_bus() is outer
+    assert outer.snapshot()["counters"].get("scoped.c", 0) == outer_count
+
+
+# --------------------------------------------------------------------- #
+# span tracer
+
+
+def test_tracer_ring_is_bounded_and_counts_drops():
+    tr = obs.SpanTracer(capacity=4)
+    for i in range(10):
+        tr.instant("e", i=i)
+    recs = tr.records()
+    assert len(recs) == 4
+    assert [r["args"]["i"] for r in recs] == [6, 7, 8, 9]  # newest kept
+    assert tr.dropped == 6
+
+
+def test_tracer_span_interval_and_attribution():
+    tr = obs.SpanTracer()
+    t0 = tr.now()
+    tr.span("compress", "compress/w1", t0, unit=5, edges=100)
+    (sp,) = tr.spans("compress")
+    assert sp["dur"] >= 0 and sp["ts"] == t0
+    assert sp["args"] == {"unit": 5, "edges": 100}
+    assert sp["track"] == "compress/w1"
+    assert isinstance(sp["tid"], int) and sp["thread"]
+
+
+def test_tracer_install_does_not_nest():
+    t1, t2 = obs.SpanTracer(), obs.SpanTracer()
+    assert obs.active_tracer() is None  # disabled is the default state
+    with obs.install(t1):
+        assert obs.active_tracer() is t1
+        with pytest.raises(RuntimeError, match="already installed"):
+            with obs.install(t2):
+                pass
+    assert obs.active_tracer() is None
+
+
+# --------------------------------------------------------------------- #
+# chrome trace export
+
+
+def test_chrome_export_golden_shape(tmp_path):
+    tr = obs.SpanTracer()
+    bus = obs.EventBus()
+    bus.inc("engine.units_folded", 3)
+    t0 = tr.now()
+    tr.span("fold", "fold", t0, unit=0)
+    tr.instant("window_close", window=1)
+    trace = obs.write_chrome_trace(str(tmp_path / "t.json"), tr, bus=bus,
+                                   extra={"capture": "test"})
+    on_disk = json.loads((tmp_path / "t.json").read_text())
+    assert on_disk == trace
+    assert on_disk["displayTimeUnit"] == "ms"
+    assert on_disk["otherData"]["trace_id"] == tr.trace_id
+    assert on_disk["otherData"]["capture"] == "test"
+    assert on_disk["otherData"]["counters"]["engine.units_folded"] == 3
+    phases = {e["ph"] for e in on_disk["traceEvents"]}
+    assert phases == {"M", "X", "i"}
+    # one named track per distinct track string + process_name
+    names = [e for e in on_disk["traceEvents"] if e["ph"] == "M"]
+    assert {e["args"]["name"] for e in names} >= {"fold", "events"}
+
+
+def test_chrome_validate_rejects_malformed():
+    ok = {"traceEvents": [], "displayTimeUnit": "ms", "otherData": {}}
+    obs.validate_chrome_trace(ok)
+    with pytest.raises(ValueError, match="traceEvents"):
+        obs.validate_chrome_trace({"otherData": {}})
+    with pytest.raises(ValueError, match="lacks required key"):
+        obs.validate_chrome_trace({"traceEvents": [{"ph": "X"}]})
+    with pytest.raises(ValueError, match="dur"):
+        obs.validate_chrome_trace({"traceEvents": [
+            {"name": "a", "ph": "X", "pid": 1, "tid": 0, "ts": 0.0},
+        ]})
+    with pytest.raises(ValueError, match="thread_name"):
+        obs.validate_chrome_trace({"traceEvents": [
+            {"name": "a", "ph": "X", "pid": 1, "tid": 9, "ts": 0.0,
+             "dur": 1.0},
+        ]})
+    with pytest.raises(ValueError, match="serializable"):
+        obs.validate_chrome_trace({"traceEvents": [], "otherData": {
+            "bad": object()}})
+
+
+# --------------------------------------------------------------------- #
+# pipelined-executor integration (the tentpole acceptance)
+
+
+def test_pipeline_spans_per_unit_with_attribution(tmp_path):
+    tr = obs.SpanTracer(heartbeat_every_s=None)
+    with obs.scope() as bus:
+        _run_cc(tracer=tr, chunk_size=2, merge_every=2)
+        trace = obs.write_chrome_trace(str(tmp_path / "cc.json"), tr,
+                                       bus=bus)
+    # 8 edges / chunk_size 2 -> 4 units (fold_batch=1). EVERY pipeline
+    # stage recorded >= 1 span PER UNIT, each carrying the unit id.
+    n_units = 4
+    for stage in ("produce", "compress", "h2d", "fold"):
+        spans = tr.spans(stage)
+        units = {sp["args"]["unit"] for sp in spans}
+        assert units == set(range(n_units)), (stage, units)
+    # worker/slot attribution: compress tracks name their pool worker,
+    # h2d tracks their double-buffer slot.
+    assert all(sp["track"].startswith("compress/")
+               for sp in tr.spans("compress"))
+    assert all(sp["track"].startswith("h2d/slot")
+               for sp in tr.spans("h2d"))
+    slots = {sp["args"]["slot"] for sp in tr.spans("h2d")}
+    assert slots <= {0, 1}  # default h2d_depth=2 rotation
+    # compress spans carry payload/edge sizes and queue depth
+    for sp in tr.spans("compress"):
+        assert sp["args"]["payload_bytes"] > 0
+        assert sp["args"]["edges"] >= 0
+        assert "queue_depth" in sp["args"]
+    # window closes: 4 units / merge_every=2 -> 2 closes, as instants
+    # AND merge_emit spans.
+    assert len(tr.instants("window_close")) == 2
+    assert len(tr.spans("merge_emit")) == 2
+    # the export validated (write_chrome_trace validates) and carries
+    # the shared trace id
+    assert trace["otherData"]["trace_id"] == tr.trace_id
+    # bus counters observed the run
+    counters = bus.snapshot()["counters"]
+    assert counters["engine.units_folded"] == n_units
+    assert counters["engine.chunks_folded"] == 8 / 2
+    assert counters["engine.edges_folded"] == len(EDGES)
+    assert counters["engine.windows_closed"] == 2
+
+
+def test_disabled_tracer_default_and_counters_still_flow():
+    # No tracer installed: active_tracer() is None (the zero-allocation
+    # guard every engine site checks) — and the always-on counters still
+    # land on the bus.
+    assert obs.active_tracer() is None
+    with obs.scope() as bus:
+        _run_cc(tracer=None)
+        counters = bus.snapshot()["counters"]
+        assert counters["engine.units_folded"] == 4
+        assert "engine.edges_folded" not in counters  # tracer-only currency
+        gauges = bus.snapshot()["gauges"]
+        assert "stage.fold_dispatch.busy_s" in gauges  # timer published
+
+
+def test_checkpoint_spans_and_bytes(tmp_path):
+    tr = obs.SpanTracer(heartbeat_every_s=None)
+    s = edge_stream_from_edges(EDGES, vertex_capacity=32, chunk_size=2)
+    agg = connected_components(32)
+    ck = str(tmp_path / "ck.npz")
+    with obs.scope() as bus:
+        with obs.install(tr):
+            s.aggregate(agg, merge_every=2, checkpoint_path=ck).result()
+        counters = bus.snapshot()["counters"]
+    spans = tr.spans("checkpoint")
+    assert spans, "checkpoint stage recorded no spans"
+    assert all(sp["args"]["bytes"] > 0 for sp in spans)
+    assert counters["engine.checkpoints"] == len(spans)
+    assert counters["engine.checkpoint_bytes"] >= sum(
+        sp["args"]["bytes"] for sp in spans) > 0
+
+
+def test_heartbeat_rate_limits_and_records():
+    clock = [0.0]
+    hb = obs.Heartbeat(every_s=10.0, clock=lambda: clock[0])
+    assert not hb.tick(position=1)  # within the interval
+    clock[0] = 10.5
+    tr = obs.SpanTracer()
+    with obs.install(tr):
+        assert hb.tick(position=2, eps=123.0)
+    clock[0] = 11.0
+    assert not hb.tick(position=3)
+    assert hb.beats == 1
+    (line,) = list(hb.lines)
+    assert line["position"] == 2 and line["eps"] == 123.0
+    (inst,) = tr.instants("heartbeat")
+    assert inst["args"]["position"] == 2
+
+
+def test_heartbeat_emitted_from_pipeline():
+    tr = obs.SpanTracer(heartbeat_every_s=0.0)  # beat on every retired unit
+    with obs.scope():
+        _run_cc(tracer=tr)
+    beats = tr.instants("heartbeat")
+    assert beats, "no heartbeat instants on an every-unit cadence"
+    last = beats[-1]["args"]
+    assert last["position"] == 4          # last-retired CHUNK position
+    assert "eps" in last and "staged_depth" in last and "h2d_depth" in last
+
+
+# --------------------------------------------------------------------- #
+# fault-injection visibility
+
+
+@pytest.mark.faults
+def test_every_injected_fault_is_an_instant_event():
+    from gelly_tpu.engine.resilience import (
+        ResilienceConfig,
+        ResilientRunner,
+        RetryPolicy,
+    )
+
+    def step(s, c):
+        return s + np.int64(c), None
+
+    plan = faults.FaultPlan([
+        faults.Fault("step", at=1, count=2),
+        faults.Fault("h2d", at=3, count=1),
+    ])
+    tr = obs.SpanTracer()
+    with obs.scope() as bus:
+        with obs.install(tr), faults.install(plan):
+            runner = ResilientRunner(
+                step, list(range(10)), np.int64(0),
+                stage=lambda c: c,
+                config=ResilienceConfig(
+                    retry=RetryPolicy(max_attempts=4, base_delay=0.001,
+                                      max_delay=0.01),
+                    watchdog_timeout=None,
+                ),
+            )
+            assert int(runner.run()) == sum(range(10))
+        counters = bus.snapshot()["counters"]
+    assert len(plan.fired) == 3
+    instants = tr.instants("faults.injected")
+    assert len(instants) == len(plan.fired)
+    assert ([(i["args"]["boundary"], i["args"]["index"]) for i in instants]
+            == [(b, idx) for b, idx, _k in plan.fired])
+    assert counters["faults.injected"] == 3
+    # the retries that recovered from them are counters too, not log text
+    assert counters["resilience.retries"] == 3
+    retry_instants = tr.instants("resilience.retries")
+    assert {i["args"]["boundary"] for i in retry_instants} == {"step", "h2d"}
+
+
+@pytest.mark.faults
+def test_pipeline_codec_fault_instant_in_trace():
+    # A seeded fault at the engine's codec boundary: the injection is
+    # visible on the trace/bus even though the pipelined executor
+    # propagates it (no retry inside the pipeline).
+    plan = faults.FaultPlan([faults.Fault("codec", at=1, count=1)])
+    tr = obs.SpanTracer(heartbeat_every_s=None)
+    with obs.scope() as bus:
+        with obs.install(tr), faults.install(plan):
+            s = edge_stream_from_edges(EDGES, vertex_capacity=32,
+                                       chunk_size=2)
+            agg = connected_components(32)
+            with pytest.raises(faults.FaultInjected):
+                s.aggregate(agg, merge_every=2).result()
+        assert bus.snapshot()["counters"]["faults.injected"] == 1
+    (inst,) = tr.instants("faults.injected")
+    assert inst["args"]["boundary"] == "codec"
+
+
+# --------------------------------------------------------------------- #
+# sharded-state gauges
+
+
+def test_sharded_cc_dirty_row_gauges():
+    from gelly_tpu.parallel.sharded_cc import ShardedCC
+
+    with obs.scope() as bus:
+        cc = ShardedCC(64)
+        cc.fold(np.array([1, 2, 3]), np.array([2, 3, 4]))
+        labels = cc.labels()
+        snap = bus.snapshot()
+    assert labels[1] == labels[4] == 1
+    assert snap["gauges"]["sharded_cc.window_dirty_rows"] >= 4
+    assert snap["gauges"]["sharded_cc.window_dirty_max_shard"] >= 1
+    assert snap["counters"]["sharded_cc.dirty_rows_gathered"] >= 4
+    assert (snap["counters"].get("sharded_cc.emissions_dense", 0)
+            + snap["counters"].get("sharded_cc.emissions_sparse", 0)) == 1
+
+
+# --------------------------------------------------------------------- #
+# overhead smoke (the strict <2% contract is measured on the real
+# streaming_cc_large capture by bench.py's obs block; CI machines are
+# too noisy for 2% — this smoke asserts the plumbing costs little and
+# the results stay bit-identical)
+
+
+@pytest.mark.slow  # CI's obs lane runs it (no marker filter there);
+# the strict <2% contract is the bench obs block's, on TPU captures.
+def test_tracer_overhead_smoke():
+    import time
+
+    rng = np.random.default_rng(3)
+    n_e, n_v = 60_000, 1 << 12
+    edges = list(zip(rng.integers(0, n_v, n_e).tolist(),
+                     rng.integers(0, n_v, n_e).tolist()))
+
+    def run(tracer):
+        s = edge_stream_from_edges(edges, vertex_capacity=n_v,
+                                   chunk_size=8192)
+        agg = connected_components(n_v)
+        t0 = time.perf_counter()
+        if tracer is None:
+            labels = s.aggregate(agg, merge_every=4).result()
+        else:
+            with obs.install(tracer):
+                labels = s.aggregate(agg, merge_every=4).result()
+        return np.asarray(labels), time.perf_counter() - t0
+
+    # Warm compile, then best-of-3 each way.
+    run(None)
+    off = min(run(None)[1] for _ in range(3))
+    with obs.scope():
+        l_off = run(None)[0]
+        best_on, l_on = float("inf"), None
+        for _ in range(3):
+            tr = obs.SpanTracer(heartbeat_every_s=None)
+            l_on, dt = run(tr)
+            best_on = min(best_on, dt)
+    assert np.array_equal(l_off, l_on)  # tracing never changes results
+    overhead = best_on / off - 1.0
+    assert overhead < 0.5, f"tracer overhead {overhead:.1%} on smoke run"

@@ -240,6 +240,90 @@ def test_repeated_native_errors_degrade_to_fallback():
         native.reenable("fake_stem")
 
 
+def test_bus_counters_observe_injection_matrix(tmp_path):
+    # ISSUE 5 acceptance: retries, degradations, and checkpoint misses
+    # in the injection matrix are observable as EVENT-BUS COUNTERS, not
+    # just log lines. One run drives all three ladders — a retried step
+    # fault, a native degradation, and a hung checkpoint write — and the
+    # bus must count every one of them (plus the injections themselves).
+    from gelly_tpu import obs
+
+    def boom():
+        e = MemoryError("native alloc failed")
+        e.stem = "bus_stem"
+        return e
+
+    def native_step(s, c):
+        faults.inject("native")
+        return _step(s, c)
+
+    plan = faults.FaultPlan([
+        faults.Fault("step", at=1, count=1),            # retried to success
+        faults.Fault("native", at=4, count=100, exc=boom),  # degrades
+        faults.Fault("checkpoint_write", at=1, kind="hang",
+                     hang_seconds=10.0),                # one tolerated miss
+    ])
+    try:
+        with obs.scope() as bus:
+            with faults.install(plan):
+                r = ResilientRunner(
+                    native_step, list(range(10)), np.int64(0),
+                    checkpoint_dir=str(tmp_path),
+                    config=_fast(degrade_after=2, checkpoint_every_chunks=3,
+                                 watchdog_timeout=0.3),
+                    fallback_step=_step,
+                )
+                final = r.run()
+            counters = bus.snapshot()["counters"]
+            gauges = bus.snapshot()["gauges"]
+    finally:
+        native.reenable("bus_stem")
+    assert int(final) == int(_clean_run(10))
+    # every ladder is countable off the bus, matching the runner's stats
+    assert counters["resilience.retries"] == r.stats["retries"] >= 1
+    assert counters["resilience.degradations"] == 1
+    assert counters["resilience.checkpoint_misses"] \
+        == r.stats["checkpoint_failures"] == 1
+    # The bus counts COMPLETED writes (the hung one never completes);
+    # runner stats count non-raising save() initiations — both present,
+    # deliberately different currencies.
+    assert counters["resilience.checkpoints"] >= 1
+    assert counters["faults.injected"] == len(plan.fired) >= 4
+    # durability currency rides along: bytes written + last write latency
+    assert counters["resilience.checkpoint_bytes"] > 0
+    assert gauges["resilience.checkpoint_write_s"] >= 0
+
+
+def test_bus_counts_watchdog_fires_and_source_restarts():
+    from gelly_tpu import obs
+
+    fails = {"n": 0}
+
+    def make_iter(pos):
+        def gen():
+            for i in range(pos, 8):
+                if i == 5 and fails["n"] == 0:
+                    fails["n"] = 1
+                    raise OSError("source hiccup")
+                yield i
+        return gen()
+
+    plan = faults.FaultPlan([
+        faults.Fault("step", at=2, kind="hang", hang_seconds=5.0),
+    ])
+    with obs.scope() as bus:
+        with faults.install(plan):
+            r = ResilientRunner(
+                _step, make_iter, np.int64(0),
+                config=_fast(watchdog_timeout=0.2),
+            )
+            final = r.run()
+        counters = bus.snapshot()["counters"]
+    assert int(final) == int(_clean_run(8))
+    assert counters["resilience.watchdog_timeouts"] >= 1
+    assert counters["resilience.source_restarts"] == r.stats["restarts"] == 1
+
+
 def test_source_failure_restarts_without_loss():
     fails = {"n": 0}
 
