@@ -9,6 +9,15 @@ from .checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
+from .coordination import (
+    CheckpointStore,
+    CoordinationConfig,
+    CoordinationError,
+    Coordinator,
+    HostIdentity,
+    ManifestCorruptError,
+    MixedEpochError,
+)
 from .resilience import (
     CheckpointManager,
     ResilienceConfig,

@@ -175,6 +175,17 @@ class SummaryAggregation:
     # batch that cannot align with the shard count) instead of silently
     # falling back to the raw fold.
     requires_codec: bool = False
+    # Optional cadenced path flatten: ``flatten(summary) -> summary``
+    # with IDENTICAL labels (e.g. unionfind.pointer_jump on the parent
+    # leaf). The pair-sized folds (union_pairs_rooted/star) and the
+    # dirty-delta merge deliberately skip the O(capacity) global flatten
+    # per dispatch, so transform chase depth grows O(1) per window on
+    # long streams; the engine runs this (jitted) once per CHECKPOINT
+    # cadence — full-capacity work amortized over the checkpoint
+    # interval, keeping chase depth bounded for the whole stream. The
+    # flattened summary REPLACES the live state (and is what the
+    # checkpoint snapshots).
+    flatten: Callable[[Summary], Summary] | None = None
     # Declares fold(combine(a, b), c) == combine(a, fold(b, c)) — folding
     # into an already-combined summary equals combining afterwards (true
     # for pure edge-set summaries: CC forests, parity forests, degree
@@ -613,9 +624,15 @@ def _compiled_plan(agg: SummaryAggregation, m):
     else:
         transform_fn = agg.transform
 
+    # The cadenced path flatten, jitted but NOT donating: at checkpoint
+    # cadence the pre-flatten summary may still be held by a consumer
+    # (the accumulate plan yields the live state), so the old buffers
+    # must survive the call.
+    flatten_fn = jax.jit(agg.flatten) if agg.flatten is not None else None
+
     plan = (fold_step, merge_locals, merger_step, locals0_fn,
             transform_fn, fold_many, fold_codec, delta_count_fn,
-            merge_delta_for)
+            merge_delta_for, flatten_fn)
     per_agg[key] = plan
     return plan
 
@@ -776,7 +793,7 @@ def run_aggregation(
     plan = _compiled_plan(agg, m)
     (fold_step, merge_locals, merger_step, locals0_fn,
      transform_fn, fold_many, fold_codec, delta_count_fn,
-     merge_delta_for) = plan
+     merge_delta_for, flatten_fn) = plan
 
     if timer is None:
         from ..utils.metrics import StageTimer
@@ -933,6 +950,10 @@ def run_aggregation(
                     transform_fn(global_summary)
                     if transform_fn else global_summary
                 )
+            # The cross-shard merge boundary: seeded FaultPlans can
+            # raise/hang here (a collective that dies mid-window), the
+            # same way they drive the native/H2D/step/checkpoint paths.
+            faults_mod.inject("collective")
             merged = None
             mode = "replicated"
             if delta_count_fn is not None:
@@ -994,13 +1015,24 @@ def run_aggregation(
             # written FIRST; resume verifies both files carry the same
             # position, so a crash between the two writes is detected
             # loudly instead of silently dropping buffered edges.
-            nonlocal last_ckpt_windows
+            nonlocal last_ckpt_windows, locals_, global_summary
             if not checkpoint_path:
                 return
             if not force and windows_closed - last_ckpt_windows < checkpoint_every:
                 return
             last_ckpt_windows = windows_closed
             t_ck = tracer.now() if tracer is not None else 0.0
+            # Cadenced path flatten (SummaryAggregation.flatten): bound
+            # the transform chase depth the pair-sized folds and delta
+            # merges let grow, exactly at the cadence the full-capacity
+            # cost is already being paid (the snapshot's device_get).
+            # The flattened summary REPLACES the live state — labels
+            # are identical by the flatten contract.
+            if flatten_fn is not None:
+                if accum:
+                    locals_ = flatten_fn(locals_)
+                else:
+                    global_summary = flatten_fn(global_summary)
             if accum:
                 snap = locals_  # the running summary holds every edge
             else:

@@ -47,9 +47,33 @@ class CheckpointCorruptError(ValueError):
     """
 
 
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync — makes the rename itself durable.
+    Some filesystems reject O_RDONLY directory fsync; that is their
+    durability model, not an error this layer can act on."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(path: str, summary, position: int = 0,
-                    meta: dict | None = None) -> None:
-    """Atomically write ``summary`` (any pytree of arrays) + stream position."""
+                    meta: dict | None = None, fsync: bool = True) -> dict:
+    """Atomically AND durably write ``summary`` (any pytree of arrays)
+    plus the stream position: tmp file → fsync → rename → directory
+    fsync. Readers see the previous checkpoint or this one in full,
+    never a torn file — and after return the bytes are on the platter,
+    so a kernel crash cannot resurrect a pre-write view after rotation
+    has pruned the fallback. ``fsync=False`` skips both syncs for
+    throwaway stores (tests that measure cadence, not durability).
+    Returns the written header dict (rotation cross-checks its CRC
+    list against the on-disk header without re-reading the payload)."""
     if position < 0:
         raise ValueError(f"checkpoint position must be >= 0, got {position}")
     leaves, treedef = jax.tree.flatten(summary)
@@ -73,11 +97,40 @@ def save_checkpoint(path: str, summary, position: int = 0,
             np.savez(f, __header__=np.frombuffer(
                 json.dumps(header).encode(), dtype=np.uint8
             ), **arrays)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    if fsync:
+        _fsync_dir(d)
+    return header
+
+
+def read_checkpoint_header(path: str) -> dict:
+    """Parse ONLY the ``__header__`` entry (schema version, position,
+    per-leaf CRC list) — a few-KB read. A torn/truncated file fails
+    here (the zip central directory lives at EOF), wrapped as
+    :class:`CheckpointCorruptError`; used by rotation to cross-check a
+    just-written file against the CRCs computed during the write
+    without re-reading the whole payload."""
+    try:
+        with np.load(path) as z:
+            header = json.loads(bytes(z["__header__"]).decode())
+    except (zipfile.BadZipFile, KeyError, OSError, ValueError,
+            json.JSONDecodeError, zlib.error, EOFError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} header unreadable (torn write?): {e}"
+        ) from e
+    if not isinstance(header, dict):
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: header is {type(header).__name__}, "
+            "expected an object"
+        )
+    return header
 
 
 def _validate_leaf(i: int, arr: np.ndarray, template, path: str) -> None:

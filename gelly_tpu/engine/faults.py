@@ -12,11 +12,22 @@ bindings (``utils/native.py``) call :func:`inject`:
 - ``"h2d"``               — host→device staging of a chunk
 - ``"step"``              — the jitted ``step(state, chunk)`` dispatch
 - ``"source"``            — the chunk source / prefetch worker
+- ``"collective"``        — the cross-shard window-close merge (the
+                            engine's butterfly/hierarchical/delta merge
+                            dispatch in ``close_window``)
+- ``"barrier"``           — the multi-host coordination protocol
+                            (``engine/coordination.py``): fires with the
+                            intent path inside ``agree_position``, at
+                            ``publish`` entry, and with the manifest
+                            path right after a commit — so
+                            ``kind="corrupt"`` there models a torn
+                            manifest
 - ``"checkpoint_write"``  — before a checkpoint file write
 - ``"checkpoint_read"``   — before a checkpoint file read
 - ``"checkpoint_corrupt"``— after a checkpoint write, with the file path
-                            (the only boundary where ``kind="corrupt"``
-                            mutates the file to simulate a torn write)
+                            (``kind="corrupt"`` mutates the file at
+                            path-carrying boundaries to simulate a torn
+                            write)
 
 Faults fire by per-boundary call index, so a plan is reproducible
 run-to-run regardless of thread interleaving at other boundaries; the only
@@ -41,6 +52,8 @@ BOUNDARIES = (
     "h2d",
     "step",
     "source",
+    "collective",
+    "barrier",
     "checkpoint_write",
     "checkpoint_read",
     "checkpoint_corrupt",

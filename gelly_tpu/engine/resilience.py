@@ -69,6 +69,7 @@ from .checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
+from .coordination import CoordinationError
 
 logger = logging.getLogger("gelly_tpu.resilience")
 
@@ -266,7 +267,9 @@ class CheckpointManager:
         while True:
             try:
                 faults_mod.inject("checkpoint_write", path=path)
-                save_checkpoint(path, host, position=position, meta=meta)
+                header = save_checkpoint(
+                    path, host, position=position, meta=meta
+                )
                 break
             except BaseException as e:
                 attempt += 1
@@ -284,10 +287,47 @@ class CheckpointManager:
         # Torn-write simulation point: fires AFTER the file is durable so a
         # corrupt fault produces exactly the artifact load must survive.
         faults_mod.inject("checkpoint_corrupt", path=path)
-        self._rotate()
+        self._rotate(expected_crcs=header["crc32"])
 
-    def _rotate(self) -> None:
-        for old in self.list()[:-self.keep]:
+    def _rotate(self, expected_crcs: list | None = None) -> None:
+        files = self.list()
+        if len(files) <= self.keep:
+            return
+        # Validate the just-written newest file BEFORE pruning its
+        # fallbacks: a torn final write (the checkpoint_corrupt fault
+        # models it) must never leave the rotation with ZERO valid
+        # checkpoints. CRC detects the tear at load either way; the
+        # point here is that the previous file is still there to fall
+        # back to. The check is a HEADER-ONLY read (few KB — the zip
+        # central directory lives at EOF, so any truncation fails it)
+        # cross-checked against the CRCs computed during the write;
+        # with no expected list (direct callers), fall back to the full
+        # CRC read-back.
+        try:
+            if expected_crcs is not None:
+                from .checkpoint import read_checkpoint_header
+
+                header = read_checkpoint_header(files[-1])
+                if header.get("crc32") != expected_crcs:
+                    raise CheckpointCorruptError(
+                        f"checkpoint {files[-1]}: on-disk header CRCs "
+                        "differ from the just-written ones — torn or "
+                        "clobbered write"
+                    )
+            else:
+                load_checkpoint(files[-1])
+        except (CheckpointCorruptError, OSError) as e:
+            obs_bus.get_bus().emit(
+                "resilience.rotation_skipped", path=files[-1],
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
+            logger.error(
+                "newest checkpoint %s failed post-write validation (%s); "
+                "keeping the previous rotation files as fallback",
+                files[-1], e,
+            )
+            return
+        for old in files[:-self.keep]:
             try:
                 os.unlink(old)
             except OSError:
@@ -415,6 +455,28 @@ class ResilientRunner:
     as the ``"h2d"`` boundary. ``fallback_step`` — the numpy-path step the
     driver degrades to when native keeps failing.
 
+    ``coordinator`` — an ``engine/coordination.Coordinator`` switches the
+    driver to COORDINATED checkpoints on a multi-host mesh: at cadence
+    the hosts run a checkpoint barrier (``agree_position`` — all agree
+    on the max last-retired-chunk position), each folds its own
+    partition up to the agreed position, and publishes its shard via
+    the two-phase commit (prepared markers + leader-written manifest).
+    Resume goes through ``Coordinator.recover``: manifest validation,
+    CRC-checked own-shard load, and — with ``adopt_state`` (a
+    ``combine(state, orphan_state) -> state``) — the degraded-capacity
+    takeover of a permanently lost host's shards. Mutually exclusive
+    with ``checkpoint_dir`` (the coordinator owns its store).
+    Coordination failures (dead peer, commit timeout, barrier skew) are
+    FATAL, never silently tolerated — a desynced mesh must surface; the
+    per-host watchdog still bounds hung protocol calls.
+
+    ``flatten_state`` — optional ``state -> state`` run at checkpoint
+    cadence before each snapshot (coordinated or local): the periodic
+    ``parent[parent]`` path flatten that keeps union-find transform
+    chase depth bounded on long streams. The returned state REPLACES
+    the live fold state (labels must be identical — e.g.
+    ``ops/unionfind.pointer_jump`` on the parent leaf).
+
     ``run()`` returns the final state; ``emissions()`` yields
     ``(position, emission)`` for every non-None emission as it happens.
     """
@@ -431,6 +493,9 @@ class ResilientRunner:
         stage: Callable[[Any], Any] | None = None,
         fallback_step: Callable[[Any, Any], tuple[Any, Any]] | None = None,
         meta: dict | None = None,
+        coordinator=None,
+        flatten_state: Callable[[Any], Any] | None = None,
+        adopt_state: Callable[[Any, Any], Any] | None = None,
     ):
         self._step = step
         self._make_iter = _make_seekable(chunks)
@@ -444,6 +509,28 @@ class ResilientRunner:
         self._watchdog = Watchdog(self.config.watchdog_timeout)
         self._native_failures = 0
         self._degraded = False
+        self._flatten = flatten_state
+        self._adopt = adopt_state
+        self.coordinator = coordinator
+        if coordinator is not None and checkpoint_dir is not None:
+            raise ValueError(
+                "pass checkpoint_dir OR coordinator, not both: the "
+                "coordinator owns its shared store (path-per-host epoch "
+                "layout), a local rotation dir would shadow it"
+            )
+        # Coordination calls get a LARGER watchdog budget than plain
+        # boundaries: a barrier legitimately waits up to the protocol's
+        # own barrier_timeout, whose error names the missing/dead hosts
+        # — the generic WatchdogTimeout must only fire for a genuine
+        # hang (e.g. an injected hang fault, a wedged fsync), never
+        # first, or it masks the actionable diagnosis.
+        self._barrier_watchdog = Watchdog(None)
+        if coordinator is not None:
+            wt = self.config.watchdog_timeout
+            self._barrier_watchdog = Watchdog(
+                None if wt is None
+                else wt + 2 * coordinator.config.barrier_timeout
+            )
         self.manager = None
         if checkpoint_dir is not None:
             self.manager = CheckpointManager(
@@ -537,7 +624,32 @@ class ResilientRunner:
     def _initial_state(self):
         state = (self._init_state()
                  if callable(self._init_state) else self._init_state)
-        if self.manager is not None and self._resume:
+        if self.coordinator is not None and self._resume:
+            found = self._barrier_watchdog.call(
+                lambda: self.coordinator.recover(
+                    like=state, adopt=self._adopt
+                ),
+                "barrier",
+            )
+            if found is not None:
+                rec_state, self.position, meta = found
+                if rec_state is not None:
+                    # None = a NEW host joining a smaller committed
+                    # group: fresh state, barrier-agreed position.
+                    state = jax.tree.map(np.asarray, rec_state)
+                self._meta.update(
+                    {k: v for k, v in meta.items() if k not in self._meta}
+                )
+                # The manifest IS the coordinated resume record (the
+                # shard path varies per host and may be an adopted set).
+                self.stats["resumed_from"] = (
+                    self.coordinator.store.manifest_path
+                )
+                logger.info(
+                    "coordinated resume at chunk %d (epoch %s)",
+                    self.position, self.coordinator.committed_epoch,
+                )
+        elif self.manager is not None and self._resume:
             found = self.manager.load_latest(like=state)
             if found is not None:
                 state, self.position, meta, path = found
@@ -587,6 +699,7 @@ class ResilientRunner:
             should_restart=should_restart,
             position=lambda: self.position,
         )
+        barrier: tuple[int, int] | None = None  # (epoch, agreed position)
         try:
             for chunk in chunk_iter:
                 if self._stage is not None:
@@ -604,21 +717,72 @@ class ResilientRunner:
                 self.stats["chunks"] = self.position - start
                 if emission is not None:
                     yield self.position, emission
-                if self.manager is not None:
-                    due = (
-                        self.position - last_ckpt_pos
-                        >= cfg.checkpoint_every_chunks
-                    )
-                    if not due and cfg.checkpoint_every_seconds is not None:
-                        due = (cfg.clock() - last_ckpt_time
-                               >= cfg.checkpoint_every_seconds)
-                    if due:
-                        self._checkpoint(state)
+                due = (
+                    self.position - last_ckpt_pos
+                    >= cfg.checkpoint_every_chunks
+                )
+                if not due and cfg.checkpoint_every_seconds is not None:
+                    due = (cfg.clock() - last_ckpt_time
+                           >= cfg.checkpoint_every_seconds)
+                if self.coordinator is not None:
+                    self.coordinator.maybe_beat()
+                    if barrier is None and due:
+                        # Checkpoint barrier: agree on max(last-retired)
+                        # across hosts; this host may still be behind the
+                        # agreed position — keep folding until it retires
+                        # it, THEN publish. Every host snapshots the same
+                        # position.
+                        barrier = self._barrier_watchdog.call(
+                            lambda p=self.position:
+                                self.coordinator.agree_position(p),
+                            "barrier",
+                        )
+                    if barrier is not None and self.position >= barrier[1]:
+                        state = self._checkpoint_coordinated(
+                            state, *barrier
+                        )
+                        self.state = state
+                        barrier = None
                         last_ckpt_pos = self.position
                         last_ckpt_time = cfg.clock()
-            if self.manager is not None:
+                elif self.manager is not None and due:
+                    state = self._checkpoint(state)
+                    self.state = state
+                    last_ckpt_pos = self.position
+                    last_ckpt_time = cfg.clock()
+            if self.coordinator is not None:
+                if barrier is not None:
+                    # The stream ended BELOW a pending barrier position:
+                    # another host proposed more chunks than this
+                    # partition holds. Peers are waiting for this host's
+                    # shard at a position it can never reach — surface
+                    # the skew instead of deadlocking them.
+                    raise CoordinationError(
+                        f"stream exhausted at chunk {self.position} but "
+                        f"the checkpoint barrier agreed on {barrier[1]} "
+                        "— coordinated partitions must have equal chunk "
+                        "counts"
+                    )
                 if self.position > last_ckpt_pos:
-                    self._checkpoint(state, final=True)
+                    epoch, agreed = self._barrier_watchdog.call(
+                        lambda p=self.position:
+                            self.coordinator.agree_position(p),
+                        "barrier",
+                    )
+                    if agreed != self.position:
+                        raise CoordinationError(
+                            f"hosts disagree on the final position "
+                            f"({agreed} vs {self.position}) — coordinated "
+                            "partitions must have equal chunk counts"
+                        )
+                    state = self._checkpoint_coordinated(
+                        state, epoch, agreed, final=True
+                    )
+                    self.state = state
+            elif self.manager is not None:
+                if self.position > last_ckpt_pos:
+                    state = self._checkpoint(state, final=True)
+                    self.state = state
                 self.manager.close()
         except BaseException:
             # Leave the newest durable checkpoint in place for the next
@@ -629,12 +793,32 @@ class ResilientRunner:
                 except BaseException:
                     logger.exception("checkpoint writer shutdown failed")
             raise
+        finally:
+            # The runner owns the coordinator's lifecycle for the run:
+            # closing stops the lease beat thread (peers see this host
+            # depart within lease_ttl) and drops the observability
+            # registration — one Coordinator per incarnation.
+            if self.coordinator is not None:
+                try:
+                    self.coordinator.close()
+                except BaseException:
+                    logger.exception("coordinator shutdown failed")
 
-    def _checkpoint(self, state, final: bool = False) -> None:
+    def _flattened(self, state):
+        """Apply the cadenced path flatten (when configured) — the
+        returned state replaces the live fold state, so chase depth
+        stays bounded across the whole stream, not just in snapshots."""
+        if self._flatten is None:
+            return state
+        return self._flatten(state)
+
+    def _checkpoint(self, state, final: bool = False):
         """Cadenced snapshot. A failed MID-STREAM checkpoint (hung write,
         exhausted write retries) degrades durability but must not kill an
         otherwise healthy fold — tolerated up to ``max_checkpoint_failures``
-        consecutive misses; the end-of-stream checkpoint always raises."""
+        consecutive misses; the end-of-stream checkpoint always raises.
+        Returns the (possibly flattened) state the fold continues with."""
+        state = self._flattened(state)
         try:
             self.manager.save(
                 state, self.position,
@@ -655,8 +839,35 @@ class ResilientRunner:
                 self.position, consecutive,
                 self.config.max_checkpoint_failures,
             )
-            return
+            return state
         self.stats["checkpoints"] += 1
+        return state
+
+    def _checkpoint_coordinated(self, state, epoch: int, agreed: int,
+                                final: bool = False):
+        """Publish this host's shard at the barrier-agreed position via
+        the coordinator's two-phase commit. Unlike the local path,
+        failures here are FATAL: a host that silently skips a
+        coordinated epoch desyncs the whole group (peers block on its
+        prepared marker), so the error must surface and take the
+        incarnation down — recovery restarts from the previous
+        committed epoch."""
+        if self.position != agreed:
+            raise CoordinationError(
+                f"coordinated checkpoint at position {self.position} but "
+                f"the barrier agreed on {agreed} — driver bug"
+            )
+        state = self._flattened(state)
+        host = jax.device_get(state)
+        self._barrier_watchdog.call(
+            lambda: self.coordinator.publish(
+                epoch, host, self.position,
+                meta={**self._meta, "wall_time": time.time()},
+            ),
+            "barrier",
+        )
+        self.stats["checkpoints"] += 1
+        return state
 
     def run(self):
         """Drain the stream; return the final state pytree."""

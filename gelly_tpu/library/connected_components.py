@@ -467,6 +467,15 @@ def connected_components_compact(
             jnp.where(ok, s.vertex_of, n)
         ].set(jnp.where(ok, lab_c, -1), mode="drop")
 
+    def flatten(s: CCCompactSummary) -> CCCompactSummary:
+        # Cadenced path flatten: the star/rooted pair folds skip the
+        # global flatten per dispatch (their documented contract), so
+        # croot chase depth grows on long streams; one pointer_jump at
+        # checkpoint cadence bounds it. vertex_of is depth-free.
+        return CCCompactSummary(
+            unionfind.pointer_jump(s.croot), s.vertex_of
+        )
+
     agg = SummaryAggregation(
         init=init,
         fold=fold,
@@ -478,6 +487,7 @@ def connected_components_compact(
         fold_compressed=fold_segments if use_segments else fold_compressed,
         stack_payloads=stack_segments if use_segments else stack_compact,
         fold_accumulates=True,
+        flatten=flatten,
         requires_codec=True,
         stack_ordered=True,
         on_stage_error=session.complete_turn,
@@ -794,6 +804,14 @@ def connected_components(
     def transform(s: CCSummary) -> jax.Array:
         return unionfind.component_labels(s.parent, s.seen)
 
+    def flatten(s: CCSummary) -> CCSummary:
+        # Cadenced path flatten (engine runs it at checkpoint cadence):
+        # the delta merge's union_pairs_rooted grows chase depth O(1)
+        # per window; one full pointer_jump here keeps depth <= 1 across
+        # arbitrarily long streams. Labels are unchanged — pointer_jump
+        # only shortcuts chains to the same roots.
+        return CCSummary(unionfind.pointer_jump(s.parent), s.seen)
+
     _mk_delta, _mk_count = _cc_merge_delta(n)
 
     return SummaryAggregation(
@@ -815,6 +833,7 @@ def connected_components(
             stack_sparse if (ingest_combine and sparse) else None
         ),
         fold_accumulates=True,  # CC forests are pure edge-set summaries
+        flatten=flatten,
         fold_backend=backend,
         merge_mode=mode,
         merge_delta=_mk_delta,

@@ -16,9 +16,16 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``resilience.source_restarts``        chunk-source reopenings
 ``resilience.checkpoints``            completed checkpoint writes
 ``resilience.checkpoint_misses``      tolerated mid-stream ckpt failures
+``resilience.rotation_skipped``       torn-newest prune refusals
 ``resilience.checkpoint_bytes``       cumulative checkpoint file bytes
 ``resilience.checkpoint_write_s``     last write latency (gauge)
 ``faults.injected``                   FaultPlan faults that fired
+``coordination.barrier_agreed``       checkpoint barriers resolved
+``coordination.prepared``             2PC shard votes written
+``coordination.committed``            leader manifest commits
+``coordination.leader_elected``       observed leadership changes
+``coordination.rejoins``              restart-time re-joins
+``coordination.degradations``         degraded-capacity takeovers
 ``engine.units_folded``               pipeline units retired by a fold
 ``engine.chunks_folded``              chunks inside those units
 ``engine.edges_folded``               valid edges (tracer-enabled runs)

@@ -69,10 +69,16 @@ def to_chrome_trace(tracer: SpanTracer, bus=None,
         elif r["ph"] == "i":
             ev["s"] = "g"
         events.append(ev)
+    from .heartbeat import host_fields
+
     other = {
         "trace_id": tracer.trace_id,
         "span_capacity": tracer.capacity,
         "spans_dropped": tracer.dropped,
+        # Host identity (process_index/count, coordinator address,
+        # leader flag): multi-host Perfetto captures — one trace file
+        # per host — stay attributable after they leave the machine.
+        "host": host_fields(),
     }
     if bus is not None:
         other.update(bus.snapshot())

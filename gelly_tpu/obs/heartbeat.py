@@ -11,7 +11,12 @@ active span tracer so exported traces show the beats on the timeline.
 The line carries the fields ISSUE 5 names: edges/sec so far, the
 pipeline queue depths (read from the bus gauges the prefetch legs
 publish), and the last-retired chunk position (the exactly-once resume
-point — what a crash right now would resume from).
+point — what a crash right now would resume from). Every line also
+carries HOST IDENTITY (``process_index`` / ``process_count`` /
+``coordinator_address`` from ``parallel/mesh.host_info``, plus the
+live ``leader`` flag when a coordinated-recovery ``Coordinator`` is
+active) so interleaved multi-host logs and Perfetto captures are
+attributable per host.
 """
 
 from __future__ import annotations
@@ -21,6 +26,23 @@ import threading
 import time
 
 logger = logging.getLogger("gelly_tpu.obs")
+
+
+def host_fields() -> dict:
+    """Static host identity plus the live leadership flag — merged into
+    every heartbeat line and into exported traces' ``otherData``.
+    Lazy imports keep ``obs`` importable standalone; leadership comes
+    from the active ``engine/coordination.Coordinator`` (absent → the
+    ``leader`` key is omitted, single-host logs stay unchanged)."""
+    from ..parallel.mesh import host_info
+
+    fields = host_info()
+    from ..engine.coordination import leader_flag
+
+    leader = leader_flag()
+    if leader is not None:
+        fields["leader"] = leader
+    return fields
 
 
 class Heartbeat:
@@ -55,7 +77,10 @@ class Heartbeat:
                 return False
             self._last = now
             self.beats += 1
-        line = dict(fields, beat=self.beats)
+        # Host identity rides every line (beats are rate-limited, so the
+        # two lazy imports + leadership read cost nothing on the hot
+        # path — tick() returns above long before this).
+        line = dict(host_fields(), **fields, beat=self.beats)
         self.lines.append(line)
         logger.info(
             "heartbeat %s",

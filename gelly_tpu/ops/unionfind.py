@@ -469,6 +469,35 @@ def merge_forest_stack(stacked: jax.Array) -> jax.Array:
     return union_edges(fresh_forest(n), idx, dsts, jnp.ones((k * n,), bool))
 
 
+def chase_depth(parent) -> int:
+    """Maximum chain length in the forest — the number of ``x = p[x]``
+    hops the deepest slot needs to reach its root. Host-side (numpy)
+    diagnostic: 0 for the identity forest, 1 for a flat forest, and the
+    quantity the pair-sized folds (:func:`union_pairs_rooted`,
+    :func:`union_pairs_star`) and the dirty-delta merge let grow O(1)
+    per dispatch/window. The cadenced flatten
+    (``SummaryAggregation.flatten`` / ``ResilientRunner(flatten_state=)``
+    → :func:`pointer_jump`) exists to keep this bounded on long streams;
+    its regression test asserts post-flatten depth <= 2.
+    """
+    import numpy as np
+
+    p = np.asarray(parent)
+    x = np.arange(p.shape[0], dtype=p.dtype)
+    # An acyclic forest fixes within n hops; more means a cycle — a
+    # corrupt forest is exactly what a diagnostic gets pointed at, so
+    # bound the walk instead of hanging.
+    for depth in range(p.shape[0] + 1):
+        nx = p[x]
+        if np.array_equal(nx, x):
+            return depth
+        x = nx
+    raise ValueError(
+        f"parent array of {p.shape[0]} slots has no root fixpoint "
+        "within n hops — the forest contains a cycle"
+    )
+
+
 def component_labels(parent: jax.Array, seen: jax.Array) -> jax.Array:
     """Labels for seen vertices (min slot in component); -1 for unseen slots."""
     p = pointer_jump(parent)

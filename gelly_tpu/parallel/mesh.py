@@ -21,6 +21,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SHARD_AXIS = "shards"
 
+# Recorded by initialize_multihost so observability (heartbeat lines,
+# Chrome-trace otherData) can attribute a capture to its cluster without
+# re-deriving launcher state. None on single-process / auto-detected runs.
+_COORDINATOR_ADDRESS: str | None = None
+
 # Compat shim: jax.shard_map graduated from jax.experimental.shard_map
 # (jax <= 0.4.x, where the replication-check kwarg is spelled check_rep)
 # to the top-level namespace (check_vma). Resolve once at import.
@@ -65,6 +70,25 @@ def initialize_multihost(coordinator_address: str | None = None,
     if process_id is not None:
         kw["process_id"] = process_id
     jax.distributed.initialize(**kw)
+    global _COORDINATOR_ADDRESS
+    _COORDINATOR_ADDRESS = coordinator_address
+
+
+def host_info() -> dict:
+    """This process's mesh identity — the host fields heartbeat lines
+    and Chrome-trace ``otherData`` carry so multi-host captures are
+    attributable per host: ``process_index`` / ``process_count`` (0/1
+    on single-process runs) and the ``coordinator_address`` recorded by
+    :func:`initialize_multihost` (None when not multihost)."""
+    try:
+        idx, cnt = jax.process_index(), jax.process_count()
+    except Exception:  # pre-backend-init edge: identity is still useful
+        idx, cnt = 0, 1
+    return {
+        "process_index": int(idx),
+        "process_count": int(cnt),
+        "coordinator_address": _COORDINATOR_ADDRESS,
+    }
 
 
 def make_mesh(num_shards: int | None = None, devices=None) -> Mesh:

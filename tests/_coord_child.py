@@ -1,0 +1,243 @@
+"""Subprocess body for the coordinated multi-host recovery tests
+(tests/test_coordinated_recovery.py).
+
+One host of a 2-process gloo mesh (jax.distributed over loopback): joins
+the cluster, folds ITS OWN partition of a deterministic edge stream
+through a coordinated ``ResilientRunner`` (checkpoint barriers + 2PC
+into the shared store, cadenced path flatten), then merges the label
+forests across hosts over the mesh and writes its outputs. The parent
+SIGKILLs one host mid-stream on the first run; the restarted pair must
+re-join at the barrier-agreed position and finish bit-identical to an
+uninterrupted run.
+
+Modes (env ``GELLY_COORD_MODE``):
+
+- ``run`` (default) — the coordinated fold described above.
+- ``golden`` — NO distributed init, NO coordinator: compute every
+  host's expected final local state sequentially (same folds, same
+  flatten cadence the runner uses) plus the merged forest, and write
+  the same output files. Shares all stream/fold code with ``run``, so
+  the bit-identical comparison is apples to apples.
+
+env: COORD, NPROCS, PID_IDX, REPO_ROOT, GELLY_COORD_{STORE,OUT,MODE}
+     GELLY_COORD_{EDGES,NV,CHUNK,SLEEP,CADENCE}
+Prints ``COORD_RESUMED <position> <chunks_folded>`` after recovery and
+``COORD_OK <pid>`` on success.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+N_EDGES = int(os.environ.get("GELLY_COORD_EDGES", "768"))
+N_V = int(os.environ.get("GELLY_COORD_NV", "96"))
+CHUNK = int(os.environ.get("GELLY_COORD_CHUNK", "16"))
+SLEEP_S = float(os.environ.get("GELLY_COORD_SLEEP", "0"))
+CADENCE = int(os.environ.get("GELLY_COORD_CADENCE", "4"))
+NPROCS = int(os.environ.get("NPROCS", "2"))
+
+
+def all_edges():
+    rng = np.random.default_rng(11)
+    pairs = rng.integers(0, N_V, (N_EDGES, 2))
+    return [(int(a), int(b)) for a, b in pairs]
+
+
+def host_stream(pid):
+    """Host ``pid``'s partition: a strided slice, equal chunk counts."""
+    from gelly_tpu import edge_stream_from_edges
+
+    part = all_edges()[pid::NPROCS]
+    return edge_stream_from_edges(
+        part, vertex_capacity=N_V, chunk_size=CHUNK
+    )
+
+
+def build_plan():
+    from gelly_tpu.library.connected_components import (
+        connected_components,
+    )
+
+    agg = connected_components(N_V)
+    return (agg, jax.jit(agg.fold), jax.jit(agg.flatten),
+            jax.jit(agg.combine))
+
+
+def write_out(out_path, local, merged_parent, merged_seen, position):
+    from gelly_tpu.engine.checkpoint import save_checkpoint
+
+    save_checkpoint(out_path, {
+        "parent": np.asarray(local.parent),
+        "seen": np.asarray(local.seen),
+        "merged_parent": np.asarray(merged_parent),
+        "merged_seen": np.asarray(merged_seen),
+    }, position=position)
+
+
+def golden():
+    """Every host's expected final local state + the merged forest,
+    replicating the coordinated runner's flatten cadence: flatten fires
+    at every barrier position (multiples of CADENCE, plus the final
+    position when it is past the last cadence point)."""
+    agg, fold, flatten, _ = build_plan()
+    locals_ = []
+    for pid in range(NPROCS):
+        s = agg.init()
+        pos = 0
+        last_ckpt = 0
+        for chunk in host_stream(pid):
+            s = fold(s, chunk)
+            pos += 1
+            if pos - last_ckpt >= CADENCE:
+                s = flatten(s)
+                last_ckpt = pos
+        if pos > last_ckpt:
+            s = flatten(s)
+        locals_.append(jax.device_get(s))
+    from gelly_tpu.ops import unionfind
+
+    mp = locals_[0].parent
+    ms = locals_[0].seen
+    merge = jax.jit(unionfind.merge_forests)
+    for other in locals_[1:]:
+        mp = merge(mp, other.parent)
+        ms = ms | other.seen
+    for pid in range(NPROCS):
+        write_out(
+            os.environ["GELLY_COORD_OUT"] + f".golden{pid}",
+            locals_[pid], mp, ms, position=0,
+        )
+    print("COORD_GOLDEN_OK")
+
+
+def run():
+    pid = int(os.environ["PID_IDX"])
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.initialize_multihost(
+        coordinator_address=os.environ["COORD"],
+        num_processes=NPROCS,
+        process_id=pid,
+    )
+    assert jax.process_count() == NPROCS
+
+    from gelly_tpu.engine.coordination import (
+        CoordinationConfig,
+        Coordinator,
+        HostIdentity,
+    )
+    from gelly_tpu.engine.resilience import (
+        ResilienceConfig,
+        ResilientRunner,
+    )
+
+    agg, fold, flatten, combine = build_plan()
+
+    def step(s, c):
+        if SLEEP_S:
+            time.sleep(SLEEP_S)
+        return fold(s, c), None
+
+    coordinator = Coordinator(
+        os.environ["GELLY_COORD_STORE"],
+        HostIdentity(pid, NPROCS,
+                     coordinator_address=os.environ["COORD"]),
+        CoordinationConfig(
+            # ttl must exceed the longest beat-free host-side stall
+            # (first-dispatch jit compiles ~1-2s on this tier); 3s keeps
+            # peer-death detection fast without false positives.
+            lease_ttl=3.0, poll_s=0.01, barrier_timeout=30.0,
+        ),
+    )
+    runner = ResilientRunner(
+        step,
+        host_stream(pid),
+        agg.init,
+        coordinator=coordinator,
+        config=ResilienceConfig(
+            checkpoint_every_chunks=CADENCE, watchdog_timeout=60.0,
+        ),
+        flatten_state=flatten,
+        adopt_state=combine,
+    )
+    try:
+        final = runner.run()
+    except BaseException as e:  # noqa: BLE001
+        # Die HARD: the normal interpreter exit would hang in
+        # jax.distributed's atexit shutdown barrier waiting for the
+        # already-dead peer — exactly the teardown this harness is
+        # crashing on purpose. The parent only asserts rc != 0.
+        import traceback
+
+        print("COORD_DEAD", type(e).__name__, e, flush=True)
+        traceback.print_exc()
+        sys.stderr.flush()
+        os._exit(1)
+    # start-of-run position = final position minus chunks folded THIS
+    # incarnation: the parent asserts the restarted pair re-entered at
+    # the manifest's barrier-agreed position.
+    print("COORD_RESUMED", runner.position - runner.stats["chunks"],
+          runner.stats["chunks"], flush=True)
+
+    # Cross-host merge over the gloo mesh (the timeWindowAll fan-in):
+    # every host contributes its local forest; shard 0's view is the
+    # global summary.
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gelly_tpu.ops import unionfind
+    from gelly_tpu.parallel import collectives
+
+    local = jax.device_get(final)
+    m = mesh_lib.make_mesh()
+    sh = NamedSharding(m, P(mesh_lib.SHARD_AXIS))
+    g_parent = jax.make_array_from_callback(
+        (NPROCS, N_V), sh,
+        lambda idx: jnp.asarray(np.asarray(local.parent)[None, :]),
+    )
+    g_seen = jax.make_array_from_callback(
+        (NPROCS, N_V), sh,
+        lambda idx: jnp.asarray(np.asarray(local.seen)[None, :]),
+    )
+
+    def merge(parent_blk, seen_blk):
+        def comb(a, b):
+            return (unionfind.merge_forests(a[0][0], b[0][0])[None],
+                    a[1] | b[1])
+
+        return collectives.butterfly_merge(
+            comb, (parent_blk, seen_blk), NPROCS
+        )
+
+    spec = P(mesh_lib.SHARD_AXIS)
+    out_parent, out_seen = mesh_lib.shard_map_fn(
+        m, merge, in_specs=(spec, spec), out_specs=(spec, spec),
+    )(g_parent, g_seen)
+    mp = np.asarray(
+        jax.device_get(out_parent.addressable_shards[0].data)
+    )[0]
+    ms = np.asarray(
+        jax.device_get(out_seen.addressable_shards[0].data)
+    )[0]
+    write_out(
+        os.environ["GELLY_COORD_OUT"] + f".{pid}", local, mp, ms,
+        position=runner.position,
+    )
+    print("COORD_OK", pid, flush=True)
+
+
+def main():
+    if os.environ.get("GELLY_COORD_MODE", "run") == "golden":
+        golden()
+    else:
+        run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,229 @@
+"""Coordinated recovery on a REAL 2-process gloo mesh — the subprocess
+proof tier (extends the ``tests/_crash_child.py`` pattern).
+
+Each worker (``tests/_coord_child.py``) joins a jax.distributed cluster
+over the loopback coordinator, folds its own edge partition through a
+coordinated ``ResilientRunner`` (checkpoint barriers + two-phase commit
+into a shared store, cadenced path flatten), and finally merges label
+forests across hosts over the mesh. The parent:
+
+1. runs a golden (single-process, shared code) pass for every host's
+   expected final local state + the merged forest;
+2. starts the pair slowed down, waits for a committed manifest, and
+   SIGKILLs one host (leader or follower) MID-WINDOW — the survivor
+   observes the lease expiry and dies loudly (bounded, no deadlock);
+3. restarts the pair: both hosts must re-join at the barrier-agreed
+   manifest position, fold only the remaining chunks, and produce
+   final states BIT-IDENTICAL to the golden pass, with the merged
+   components matching the single-process numpy oracle.
+
+Both variants are slow-marked — ~20s each of subprocess
+jax.distributed bring-up against a tier-1 budget the pre-existing suite
+nearly fills — and run on every push in the CI ``multihost`` lane; the
+protocol logic itself is tier-1-covered in-process by
+``tests/test_coordination.py``.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_coord_child.py")
+
+_STREAM = dict(
+    GELLY_COORD_EDGES="768", GELLY_COORD_NV="96",
+    GELLY_COORD_CHUNK="16", GELLY_COORD_CADENCE="4",
+)
+# 768 edges / 2 hosts / 16-edge chunks = 24 chunks per host.
+CHUNKS_PER_HOST = 24
+
+
+def _env(**extra):
+    env = dict(os.environ, REPO_ROOT=os.path.dirname(
+        os.path.dirname(os.path.abspath(CHILD))))
+    env.pop("XLA_FLAGS", None)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(_STREAM)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_pair(store, out, sleep_s):
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in (0, 1):
+        env = _env(
+            COORD=coord, NPROCS=2, PID_IDX=pid,
+            GELLY_COORD_STORE=store, GELLY_COORD_OUT=out,
+            GELLY_COORD_SLEEP=sleep_s,
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-I", CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    return procs
+
+
+def _golden(tmp_path):
+    out = str(tmp_path / "out.npz")
+    env = _env(GELLY_COORD_MODE="golden", GELLY_COORD_OUT=out, NPROCS=2)
+    r = subprocess.run(
+        [sys.executable, "-I", CHILD], env=env, capture_output=True,
+        text=True, timeout=240,
+    )
+    assert r.returncode == 0, f"golden failed\n{r.stdout}\n{r.stderr}"
+    assert "COORD_GOLDEN_OK" in r.stdout
+    return out
+
+
+def _load_out(path):
+    from gelly_tpu.engine.checkpoint import load_checkpoint
+
+    leaves, position, _ = load_checkpoint(path)
+    # dict pytree: leaves in sorted-key order
+    keys = ["merged_parent", "merged_seen", "parent", "seen"]
+    return dict(zip(keys, leaves)), position
+
+
+def _comps(parent, seen):
+    out = {}
+    for v in np.nonzero(seen)[0].tolist():
+        r = v
+        while parent[r] != r:
+            r = parent[r]
+        out.setdefault(r, set()).add(v)
+    return sorted(sorted(c) for c in out.values())
+
+
+def _oracle_comps():
+    from gelly_tpu.library.connected_components import cc_labels_numpy
+
+    rng = np.random.default_rng(11)
+    nv = int(_STREAM["GELLY_COORD_NV"])
+    pairs = rng.integers(0, nv, (int(_STREAM["GELLY_COORD_EDGES"]), 2))
+    full = cc_labels_numpy(
+        pairs[:, 0].astype(np.int64), pairs[:, 1].astype(np.int64),
+        None, nv,
+    )
+    return _comps(np.where(full >= 0, full, np.arange(nv)), full >= 0)
+
+
+def _wait_manifest(store, min_epoch, timeout=120.0):
+    path = os.path.join(store, "MANIFEST.json")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                man = json.load(f)
+            if man.get("epoch", 0) >= min_epoch:
+                return man
+        except (OSError, json.JSONDecodeError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"no manifest at epoch >= {min_epoch} in {store}")
+
+
+def _drain(procs, timeout=120.0):
+    outs = []
+    deadline = time.monotonic() + timeout
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=max(1.0, deadline
+                                                 - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+        outs.append((p.returncode, out, err))
+    return outs
+
+
+def _kill_and_restart(tmp_path, victim):
+    """Shared body: crash ``victim`` (0 = leader, 1 = follower)
+    mid-stream, restart the pair, verify barrier-position re-join and
+    bit-identical finals."""
+    golden_out = _golden(tmp_path)
+    store = str(tmp_path / "store")
+    out = str(tmp_path / "run.npz")
+
+    # Run A: slowed so the kill lands mid-stream, after >= 2 committed
+    # barriers (position >= 8 of 24).
+    procs = _spawn_pair(store, out, sleep_s=0.15)
+    try:
+        _wait_manifest(store, min_epoch=2)
+        os.kill(procs[victim].pid, signal.SIGKILL)
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    outs = _drain(procs)
+    # The survivor must die LOUDLY (lease-expiry CoordinationError /
+    # distributed teardown), never hang — _drain enforces the bound.
+    survivor_rc, _, survivor_err = outs[1 - victim]
+    assert survivor_rc != 0, "survivor should abort when its peer dies"
+    man = _wait_manifest(store, min_epoch=2)
+    resume_pos = man["position"]
+    assert 0 < resume_pos < CHUNKS_PER_HOST, (
+        f"kill did not land mid-stream (manifest at {resume_pos})"
+    )
+
+    # Run B: fresh pair over the same store — re-join and finish fast.
+    procs = _spawn_pair(store, out, sleep_s=0.0)
+    outs = _drain(procs)
+    for rc, stdout, stderr in outs:
+        assert rc == 0, f"restarted worker failed\n{stdout}\n{stderr}"
+        assert "COORD_OK" in stdout
+        # re-entry exactly at the barrier-agreed manifest position,
+        # folding only the remainder
+        resumed = [ln for ln in stdout.splitlines()
+                   if ln.startswith("COORD_RESUMED")][0].split()
+        start, folded = int(resumed[1]), int(resumed[2])
+        assert start == resume_pos
+        assert folded == CHUNKS_PER_HOST - resume_pos > 0
+
+    oracle = _oracle_comps()
+    for pid in (0, 1):
+        got, pos = _load_out(f"{out}.{pid}")
+        want, _ = _load_out(f"{golden_out}.golden{pid}")
+        assert pos == CHUNKS_PER_HOST
+        # bit-identical local summaries (the acceptance bar)
+        assert got["parent"].tobytes() == want["parent"].tobytes()
+        assert got["seen"].tobytes() == want["seen"].tobytes()
+        # merged components match the single-process oracle
+        assert _comps(got["merged_parent"], got["merged_seen"]) == oracle
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_gloo_sigkill_leader_midstream_resumes_bit_identical(tmp_path):
+    """SIGKILL the LEADER (process 0) mid-window on a live 2-process
+    gloo mesh: the restarted pair re-joins at the barrier-agreed
+    position and finishes bit-identical to the uninterrupted fold.
+    Slow-marked (~20s of subprocess jax.distributed bring-up — the
+    tier-1 budget is nearly spent by the pre-existing suite); the CI
+    ``multihost`` lane runs it on every push, and the protocol logic it
+    exercises is tier-1-covered in-process by test_coordination.py."""
+    _kill_and_restart(tmp_path, victim=0)
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_gloo_sigkill_follower_midstream_resumes_bit_identical(tmp_path):
+    """Same contract with the FOLLOWER (process 1) killed — leadership
+    never changes hands, but the leader must abort its next barrier on
+    the dead peer's lease and the restart path is identical."""
+    _kill_and_restart(tmp_path, victim=1)
