@@ -16,13 +16,22 @@ correctness-tooling floor under both:
   inside ``jax.jit``-decorated functions and their one-level callees
   (rule ids ``GL0xx``, inline ``# graphlint: disable=GLxxx``
   suppression);
+- :mod:`gelly_tpu.analysis.racecheck` — concurrency race detector for
+  the threaded runtime (thread-root discovery, shared-attribute and
+  lock-discipline rules ``RC0xx``, lock-order cycle detection) plus a
+  declarative protocol-invariant checker for
+  ``engine/coordination.py`` (rule ids ``PI0xx``), same suppression
+  machinery;
 - :mod:`gelly_tpu.analysis.sanitize` — builds the native components
   under ASan/UBSan (``GELLY_NATIVE_SANITIZE=asan|ubsan``) and drives a
   smoke workload through every fold in an ``LD_PRELOAD``-prepared
   subprocess.
 
-Run everything with ``python -m gelly_tpu.analysis`` (exits non-zero on
-any unsuppressed finding). See ``--help`` for lane selection.
+Run everything with ``python -m gelly_tpu.analysis`` (or one tool via
+``python -m gelly_tpu.analysis abi|jitlint|racecheck [paths]``); the
+exit code is non-zero iff any unsuppressed finding exists, and
+``--format=json`` emits the findings machine-readably for CI. See
+``--help`` for lane selection.
 """
 
 from __future__ import annotations

@@ -1,25 +1,49 @@
 """CLI: ``python -m gelly_tpu.analysis``.
 
-Runs the ABI cross-checker and the jit-hazard linter over the repo (and
-optionally the sanitizer smoke lane), printing findings as
-``path:line: RULE message`` and exiting non-zero on any unsuppressed
-finding. This is the gate every PR inherits (.github/workflows/
-analysis.yml); run it locally before pushing native or jit changes.
+Unified exit-code contract for every analysis tool:
+
+    python -m gelly_tpu.analysis                  # all tools (abi+jitlint+racecheck)
+    python -m gelly_tpu.analysis --all            # same, explicit
+    python -m gelly_tpu.analysis racecheck PATH…  # one tool, optional paths
+    python -m gelly_tpu.analysis jitlint
+    python -m gelly_tpu.analysis abi
+
+Findings print as ``path:line: RULE message``; a per-tool finding-count
+summary follows, and the exit code is non-zero **iff any unsuppressed
+finding exists** (suppressed lines never reach the output). This is the
+gate every PR inherits (.github/workflows/analysis.yml); run it locally
+before pushing native, jit, or threaded-runtime changes.
+
+``--format=json`` emits a machine-readable object for CI consumption::
+
+    {"tools": {"abi":       {"count": 0, "findings": []},
+               "jitlint":   {"count": 0, "findings": []},
+               "racecheck": {"count": 1, "findings": [
+                   {"path": "...", "line": 12, "rule": "RC002",
+                    "message": "...", "hint": "..."}]}},
+     "total": 1, "ok": false}
+
+The sanitizer smoke lane rides along via ``--sanitize asan|ubsan|both``
+(orthogonal to the finding tools; its failures also drive the exit code).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from . import Finding
 from . import abi as abi_mod
 from . import jitlint as jitlint_mod
+from . import racecheck as racecheck_mod
 from . import sanitize as sanitize_mod
 
 _REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", ".."))
+
+TOOLS = ("abi", "jitlint", "racecheck")
 
 
 def _list_rules() -> str:
@@ -37,18 +61,60 @@ def _list_rules() -> str:
                  "`# graphlint: disable=GLxxx`:")
     for rid, (summary, _hint) in sorted(jitlint_mod.RULES.items()):
         lines.append(f"  {rid}  {summary}")
+    lines.append("race detector + protocol invariants "
+                 "(analysis/racecheck.py), suppress with "
+                 "`# graphlint: disable=RCxxx` / `PIxxx`:")
+    for rid, (summary, _hint) in sorted(racecheck_mod.RULES.items()):
+        lines.append(f"  {rid}  {summary}")
     lines.append("sanitizer lane (analysis/sanitize.py): "
                  "--sanitize asan|ubsan, env GELLY_NATIVE_SANITIZE")
     return "\n".join(lines)
 
 
+def _finding_dict(f: Finding) -> dict:
+    return {"path": f.path, "line": f.line, "rule": f.rule,
+            "message": f.message, "hint": f.hint}
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Subcommand form: the FIRST positional token naming a tool (or
+    # "all") selects it — flags may come before it (`--format=json
+    # racecheck gelly_tpu/` works like `racecheck --format=json ...`).
+    # Tokens that are the VALUE of a preceding flag are not positionals,
+    # so a path literally named "racecheck" after --lint-path stays a
+    # path.
+    value_flags = {"--root", "--native-dir", "--bindings", "--lint-path",
+                   "--format", "--sanitize"}
+    tool = None
+    expecting_value = False
+    for i, tok in enumerate(argv):
+        if expecting_value:
+            expecting_value = False
+            continue
+        if tok.startswith("-"):
+            expecting_value = tok in value_flags  # "--flag value" form
+            continue
+        if tok in TOOLS + ("all",):
+            tool = tok
+            argv.pop(i)
+        break  # first positional decides either way
+
     ap = argparse.ArgumentParser(
         prog="python -m gelly_tpu.analysis",
         description="repo-specific static analysis: ABI cross-check of "
-                    "native/*.cc vs ctypes bindings, jit-hazard lint of "
-                    "gelly_tpu/, optional native sanitizer smoke lane",
+                    "native/*.cc vs ctypes bindings, jit-hazard lint and "
+                    "concurrency race/protocol-invariant check of "
+                    "gelly_tpu/, optional native sanitizer smoke lane. "
+                    "Subcommands: abi | jitlint | racecheck | all "
+                    "(default all).",
     )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (jitlint + racecheck; "
+                         "default ROOT/gelly_tpu)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every tool (abi+jitlint+racecheck) — the "
+                         "default when no subcommand is given")
     ap.add_argument("--root", default=_REPO_ROOT,
                     help="repo root (default: the checkout this package "
                          "lives in)")
@@ -59,12 +125,17 @@ def main(argv=None) -> int:
                          "ROOT/gelly_tpu/utils/native.py)")
     ap.add_argument("--lint-path", action="append", default=None,
                     metavar="PATH",
-                    help="file/dir to jit-lint (repeatable; default "
-                         "ROOT/gelly_tpu)")
+                    help="file/dir to lint (repeatable; alias of the "
+                         "positional paths)")
     ap.add_argument("--skip-abi", action="store_true",
                     help="skip the ABI cross-checker")
     ap.add_argument("--skip-jitlint", action="store_true",
                     help="skip the jit-hazard linter")
+    ap.add_argument("--skip-racecheck", action="store_true",
+                    help="skip the concurrency race detector")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="output format (json: one machine-readable "
+                         "object on stdout, for CI)")
     ap.add_argument("--sanitize", choices=("asan", "ubsan", "both"),
                     default=None,
                     help="also run the native smoke workload under the "
@@ -81,44 +152,75 @@ def main(argv=None) -> int:
     native_dir = args.native_dir or os.path.join(root, "native")
     bindings = args.bindings or os.path.join(
         root, "gelly_tpu", "utils", "native.py")
-    lint_paths = args.lint_path or [os.path.join(root, "gelly_tpu")]
+    lint_paths = (args.paths or args.lint_path
+                  or [os.path.join(root, "gelly_tpu")])
 
-    findings: list[Finding] = []
-    if not args.skip_abi:
-        findings += abi_mod.cross_check(native_dir, bindings)
-    if not args.skip_jitlint:
-        findings += jitlint_mod.lint_paths(root, lint_paths)
+    run = {t: True for t in TOOLS}
+    if tool in TOOLS:
+        run = {t: t == tool for t in TOOLS}
+    if args.skip_abi:
+        run["abi"] = False
+    if args.skip_jitlint:
+        run["jitlint"] = False
+    if args.skip_racecheck:
+        run["racecheck"] = False
 
-    for f in findings:
-        print(f.render())
+    per_tool: dict[str, list[Finding]] = {}
+    if run["abi"]:
+        per_tool["abi"] = abi_mod.cross_check(native_dir, bindings)
+    if run["jitlint"]:
+        per_tool["jitlint"] = jitlint_mod.lint_paths(root, lint_paths)
+    if run["racecheck"]:
+        per_tool["racecheck"] = racecheck_mod.lint_paths(root, lint_paths)
 
-    rc = 0
-    if findings:
-        print(f"{len(findings)} finding(s)", file=sys.stderr)
-        rc = 1
+    findings = [f for fs in per_tool.values() for f in fs]
+    rc = 1 if findings else 0
 
+    sanitize_lines: list[str] = []
     if args.sanitize:
         modes = ("asan", "ubsan") if args.sanitize == "both" \
             else (args.sanitize,)
         for mode in modes:
             if not sanitize_mod.sanitizer_available(mode):
-                print(f"sanitize[{mode}]: runtime unavailable "
-                      "(g++ or lib{a,ub}san missing) — skipped",
-                      file=sys.stderr)
+                sanitize_lines.append(
+                    f"sanitize[{mode}]: runtime unavailable "
+                    "(g++ or lib{a,ub}san missing) — skipped")
                 continue
             proc = sanitize_mod.run_smoke(mode)
             if proc.returncode != 0:
-                print(f"sanitize[{mode}]: FAILED (rc={proc.returncode})",
-                      file=sys.stderr)
-                sys.stderr.write(proc.stdout[-2000:])
-                sys.stderr.write(proc.stderr[-4000:])
+                sanitize_lines.append(
+                    f"sanitize[{mode}]: FAILED (rc={proc.returncode})")
+                sanitize_lines.append(proc.stdout[-2000:])
+                sanitize_lines.append(proc.stderr[-4000:])
                 rc = 1
             else:
-                print(proc.stdout.strip() or f"sanitize[{mode}]: clean")
+                sanitize_lines.append(
+                    proc.stdout.strip() or f"sanitize[{mode}]: clean")
 
+    if args.format == "json":
+        print(json.dumps({
+            "tools": {
+                t: {"count": len(fs),
+                    "findings": [_finding_dict(f) for f in fs]}
+                for t, fs in per_tool.items()
+            },
+            "sanitize": sanitize_lines or None,
+            "total": len(findings),
+            "ok": rc == 0,
+        }, indent=1))
+        return rc
+
+    for f in findings:
+        print(f.render())
+    # Per-tool summary — the exit-code contract made visible: non-zero
+    # iff any count below is non-zero (or a sanitizer lane failed).
+    for t, fs in per_tool.items():
+        print(f"{t}: {len(fs)} finding(s)",
+              file=sys.stderr if fs else sys.stdout)
+    for line in sanitize_lines:
+        print(line, file=sys.stderr if rc else sys.stdout)
     if rc == 0:
-        checks = [c for c, skip in (("abi", args.skip_abi),
-                                    ("jitlint", args.skip_jitlint)) if not skip]
+        checks = list(per_tool)
         if args.sanitize:
             checks.append(f"sanitize:{args.sanitize}")
         print(f"analysis clean ({', '.join(checks)})")

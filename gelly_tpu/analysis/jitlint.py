@@ -139,6 +139,19 @@ _SYNC_METHODS = {"item", "tolist"}
 
 _SUPPRESS_RE = re.compile(r"#\s*graphlint:\s*disable=([A-Za-z0-9,\s]+)")
 
+
+def suppressed(lines: list, line: int, rule: str) -> bool:
+    """THE ``# graphlint: disable=`` check, shared by every analysis
+    tool (jitlint GLxxx, racecheck RCxxx/PIxxx): rule in the comma list,
+    or ``all``, on the flagged line suppresses the finding. One parser —
+    a syntax extension here applies to every rule family at once."""
+    if 1 <= line <= len(lines):
+        sm = _SUPPRESS_RE.search(lines[line - 1])
+        if sm:
+            ids = {s.strip().upper() for s in sm.group(1).split(",")}
+            return rule.upper() in ids or "ALL" in ids
+    return False
+
 # Pallas-alias calls that yield TRACED values (everything else reached
 # through a pallas alias — pl.ds, pl.cdiv, pl.BlockSpec, pltpu.VMEM,
 # grid-spec constructors — is meta/concrete plumbing).
@@ -525,12 +538,7 @@ class JitLinter:
                 if p not in static and p not in ("self", "cls")}
 
     def _suppressed(self, m: _Module, line: int, rule: str) -> bool:
-        if 1 <= line <= len(m.lines):
-            sm = _SUPPRESS_RE.search(m.lines[line - 1])
-            if sm:
-                ids = {s.strip().upper() for s in sm.group(1).split(",")}
-                return rule.upper() in ids or "ALL" in ids
-        return False
+        return suppressed(m.lines, line, rule)
 
     def _emit(self, m: _Module, node: ast.AST, rule: str, detail: str,
               via: str) -> None:

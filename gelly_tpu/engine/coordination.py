@@ -488,6 +488,12 @@ class LeaseBoard:
         self.ttl = ttl
         self._clock = clock
         self._last_beat = 0.0
+        # beat() is called from BOTH the background lease thread and the
+        # protocol paths (maybe_beat per chunk, forced beats at barrier
+        # entry): the rate-limit check-then-set and the beats counter
+        # are a lost-update read-modify-write without this lock
+        # (racecheck RC001/RC002).
+        self._beat_lock = threading.Lock()
         # Incarnation boundary for expiry: only a lease beaten AT OR
         # AFTER this board existed counts as "seen alive"; an older
         # file is a previous incarnation's leftover and reads as
@@ -504,13 +510,20 @@ class LeaseBoard:
         """Refresh this host's lease (rate-limited to ttl/3); returns
         True when a write actually happened."""
         now = self._clock()
-        if not force and now - self._last_beat < self.ttl / 3.0:
-            return False
-        self._last_beat = now
-        self.beats += 1
+        with self._beat_lock:
+            if not force and now - self._last_beat < self.ttl / 3.0:
+                return False
+            self._last_beat = now
+            self.beats += 1
+            beats = self.beats
+        # The fsync'd file write stays OUTSIDE the lock: serializing the
+        # beat thread against a barrier's forced beat on a slow (NFS)
+        # store would make liveness wait on disk latency (the same
+        # discipline racecheck RC004 enforces). Concurrent force-beats
+        # both write — write_json_atomic is rename-atomic, last wins.
         write_json_atomic(self._path(self.host), {
             "host": self.host, "wall_time": now, "ttl": self.ttl,
-            "beats": self.beats,
+            "beats": beats,
         })
         return True
 

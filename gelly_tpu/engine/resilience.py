@@ -225,7 +225,13 @@ class CheckpointManager:
         # actually completes — the durability gauge the driver's
         # max_checkpoint_failures bound reads. (An abandoned writer that
         # eventually finishes resets it too: durability was achieved.)
+        # Bumped from the async writer daemon AND from flush() on the
+        # driver thread (a timed-out write counts as a miss before the
+        # abandoned writer's own accounting runs): the read-modify-write
+        # needs the lock or concurrent bumps lose updates (racecheck
+        # RC002) and the abort bound under-counts misses.
         self.consecutive_failures = 0
+        self._fail_lock = threading.Lock()
 
     def path_for(self, position: int) -> str:
         return os.path.join(self.directory, f"ckpt-{position:012d}.npz")
@@ -256,9 +262,11 @@ class CheckpointManager:
         try:
             self._write_inner(host, position, meta)
         except BaseException:
-            self.consecutive_failures += 1
+            with self._fail_lock:
+                self.consecutive_failures += 1
             raise
-        self.consecutive_failures = 0
+        with self._fail_lock:
+            self.consecutive_failures = 0
 
     def _write_inner(self, host, position: int, meta: dict | None) -> None:
         path = self.path_for(position)
@@ -267,7 +275,12 @@ class CheckpointManager:
         while True:
             try:
                 faults_mod.inject("checkpoint_write", path=path)
-                header = save_checkpoint(
+                # Vetted exception to the daemon-durability rule: this
+                # write is atomic (tmp + fsync + rename) and _rotate
+                # validates the newest file before pruning its fallbacks,
+                # so a daemon killed mid-write can only lose the newest
+                # snapshot — never leave zero valid checkpoints.
+                header = save_checkpoint(  # graphlint: disable=RC006
                     path, host, position=position, meta=meta
                 )
                 break
@@ -344,7 +357,8 @@ class CheckpointManager:
             if t.is_alive():
                 # Neither completed nor failed yet — count the miss here
                 # (_write's own accounting runs whenever it finishes).
-                self.consecutive_failures += 1
+                with self._fail_lock:
+                    self.consecutive_failures += 1
                 raise WatchdogTimeout("checkpoint_write", self.write_timeout)
             if box:
                 raise box[0]

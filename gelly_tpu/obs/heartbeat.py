@@ -77,10 +77,15 @@ class Heartbeat:
                 return False
             self._last = now
             self.beats += 1
+            # Captured INSIDE the lock: building the line from
+            # self.beats after release let two threads that both won a
+            # beat stamp the same number (every_s<=0, or ticks straddling
+            # the cadence boundary) — lines must be attributable 1:1.
+            beat_no = self.beats
         # Host identity rides every line (beats are rate-limited, so the
         # two lazy imports + leadership read cost nothing on the hot
         # path — tick() returns above long before this).
-        line = dict(host_fields(), **fields, beat=self.beats)
+        line = dict(host_fields(), **fields, beat=beat_no)
         self.lines.append(line)
         logger.info(
             "heartbeat %s",

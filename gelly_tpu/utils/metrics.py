@@ -48,13 +48,22 @@ class StageTimer:
                 self.counts[stage] += 1
 
     def report(self) -> dict[str, dict[str, float]]:
+        # Snapshot under the lock before building the report: iterating
+        # the live dicts while a prefetch worker books its first sample
+        # into a NEW stage raises "dictionary changed size during
+        # iteration" mid-report (the SpanTracer bug class, racecheck
+        # RC003) — and a stage added between reading totals and counts
+        # would divide by a missing count.
+        with self._lock:
+            totals = dict(self.totals)
+            counts = dict(self.counts)
         return {
             s: {
-                "total_s": round(self.totals[s], 6),
-                "calls": self.counts[s],
-                "mean_ms": round(1e3 * self.totals[s] / self.counts[s], 3),
+                "total_s": round(totals[s], 6),
+                "calls": counts[s],
+                "mean_ms": round(1e3 * totals[s] / counts[s], 3),
             }
-            for s in self.totals
+            for s in totals
         }
 
     def busy(self) -> dict[str, float]:

@@ -790,3 +790,47 @@ def test_chrome_trace_otherdata_carries_host_identity(tmp_path):
     assert host["process_count"] == 1
     assert "coordinator_address" in host
     assert host["leader"] is True
+
+
+# ---------------------------------------------------------------------- #
+# concurrency regression (racecheck RC001/RC002 fix): LeaseBoard.beat is
+# called from the background lease thread AND the protocol paths
+
+
+@pytest.mark.racecheck
+def test_lease_beat_concurrent_force_beats_lose_no_updates(tmp_path):
+    """beat() races the lease thread against maybe_beat/barrier force
+    beats; pre-fix the unlocked ``beats += 1`` lost updates and the
+    rate-limit check-then-set admitted overlapping writes. Post-fix the
+    counter is exact under contention."""
+    store = CheckpointStore(str(tmp_path))
+    board = LeaseBoard(store, host=0, ttl=5.0)
+    n_threads, per_thread = 8, 25
+
+    def hammer():
+        for _ in range(per_thread):
+            assert board.beat(force=True)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert board.beats == n_threads * per_thread
+    # the lease file survived the concurrent atomic writes and is fresh
+    assert board.wall(0) is not None
+    assert not board.expired(0)
+
+
+@pytest.mark.racecheck
+def test_lease_beat_rate_limit_still_rate_limits(tmp_path):
+    """The lock must not break the ttl/3 rate limit: unforced beats
+    within the window are rejected without a write."""
+    now = [100.0]
+    board = LeaseBoard(CheckpointStore(str(tmp_path)), host=0, ttl=3.0,
+                       clock=lambda: now[0])
+    assert board.beat()            # first write
+    assert not board.beat()        # inside ttl/3
+    now[0] += 1.01                 # past ttl/3 = 1.0
+    assert board.beat()
+    assert board.beats == 2

@@ -393,3 +393,31 @@ def test_tracer_overhead_smoke():
     assert np.array_equal(l_off, l_on)  # tracing never changes results
     overhead = best_on / off - 1.0
     assert overhead < 0.5, f"tracer overhead {overhead:.1%} on smoke run"
+
+
+@pytest.mark.racecheck
+def test_heartbeat_concurrent_ticks_stamp_unique_beat_numbers():
+    """Regression (racecheck RC001 class): the beat line used to read
+    self.beats AFTER releasing the lock, so two threads that both won a
+    beat could stamp the same number. Beats must be attributable 1:1."""
+    import threading
+
+    from gelly_tpu.obs.heartbeat import Heartbeat
+
+    hb = Heartbeat(every_s=0, max_lines=4096)
+    n_threads, per_thread = 8, 50
+
+    def hammer():
+        for _ in range(per_thread):
+            assert hb.tick(src=threading.get_ident())
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert hb.beats == total
+    beat_nos = [line["beat"] for line in hb.lines]
+    assert len(beat_nos) == total
+    assert sorted(beat_nos) == list(range(1, total + 1))

@@ -1,0 +1,438 @@
+"""gelly_tpu.analysis.racecheck: concurrency race detector + protocol
+invariants.
+
+Every RC rule is exercised BOTH ways — a fixture module that must flag
+(line-anchored, including the historical SpanTracer deque-iteration and
+unlocked-RMW bug classes) and a clean fixture covering the
+shadowing/suppression edge cases (lock held via a private helper,
+``list()`` snapshot, same-named attribute in an unthreaded class,
+condition-wait on the held condition) that must produce zero findings.
+The PI invariants are proven clean on repo tip and each single seeded
+violation of a scratch ``coordination.py`` flips the CLI exit code
+non-zero (ISSUE 8 acceptance)."""
+
+import json
+import os
+import shutil
+import textwrap
+
+import pytest
+
+from gelly_tpu.analysis import racecheck
+from gelly_tpu.analysis.__main__ import main as analysis_main
+
+pytestmark = pytest.mark.racecheck
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+COORDINATION = os.path.join(REPO, "gelly_tpu", "engine", "coordination.py")
+
+
+def _lint_src(tmp_path, src, name="fixture_mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return racecheck.lint_paths(str(tmp_path), [str(p)])
+
+
+def _line_of(src, marker):
+    for i, line in enumerate(src.splitlines(), 1):
+        if marker in line:
+            return i
+    raise AssertionError(f"marker {marker!r} not in fixture")
+
+
+# --------------------------------------------------------------------- #
+# repo tip
+
+def test_racecheck_clean_on_repo_tip():
+    findings = racecheck.lint_paths(REPO, [os.path.join(REPO, "gelly_tpu")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_repo_tip_discovers_the_real_thread_roots():
+    # The clean result above is vacuous if discovery saw no threads: the
+    # checker must find the runtime's actual roots — the checkpoint
+    # writer and watchdog daemons, the lease-beat thread, the prefetch
+    # worker/submitter, and the pipeline's codec-worker bodies.
+    c = racecheck.RaceChecker(REPO)
+    c.lint_paths([os.path.join(REPO, "gelly_tpu")])
+    names = {r.entry.name for r in c.roots}
+    assert {"writer", "run", "_beat_loop", "worker", "submitter",
+            "stage_unit"} <= names
+    assert any(r.daemon for r in c.roots)
+    # and the cross-class typed descent reached LeaseBoard through
+    # Coordinator._beat_loop -> self.board.beat()
+    assert any(key[1] == "LeaseBoard" for key, _ in c.accesses.items()
+               for key in [key[0]])
+
+
+# --------------------------------------------------------------------- #
+# rule fixtures: every rule must flag, line-anchored
+
+RACY_SRC = textwrap.dedent('''\
+    import queue
+    import threading
+
+    from gelly_tpu.engine.checkpoint import save_checkpoint
+
+
+    class Pipeline:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self.items = []
+            self.inbox = queue.Queue()
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                self.items.append(self.inbox.get())
+                self.count = self.count + 1          # M-RMW-ROOT
+
+        def set_zero(self):
+            self.count = 0                           # M-RC001
+
+        def bump(self):
+            self.count += 1                          # M-RC002
+
+        def snapshot(self):
+            return [x for x in self.items]           # M-RC003
+
+        def drain_locked(self):
+            with self._lock:
+                return self.inbox.get()              # M-RC004
+
+
+    class Ordered:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def one(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def two(self):
+            with self.b:
+                with self.a:                         # M-RC005
+                    pass
+
+
+    def spawn_checkpointer(path, state):
+        def writer():
+            save_checkpoint(path, state, position=0)  # M-RC006
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+''')
+
+
+def test_flags_every_rule_line_anchored(tmp_path):
+    findings = _lint_src(tmp_path, RACY_SRC)
+    got = {(f.rule, f.line) for f in findings}
+    expected = {
+        ("RC002", _line_of(RACY_SRC, "M-RMW-ROOT")),
+        ("RC001", _line_of(RACY_SRC, "M-RC001")),
+        ("RC002", _line_of(RACY_SRC, "M-RC002")),
+        ("RC003", _line_of(RACY_SRC, "M-RC003")),
+        ("RC004", _line_of(RACY_SRC, "M-RC004")),
+        ("RC005", _line_of(RACY_SRC, "M-RC005")),
+        ("RC006", _line_of(RACY_SRC, "M-RC006")),
+    }
+    assert got == expected, "\n".join(f.render() for f in findings)
+    # findings carry real anchors and hints
+    for f in findings:
+        assert f.path.endswith("fixture_mod.py") and f.line > 0 and f.hint
+
+
+# --------------------------------------------------------------------- #
+# historical bug classes, reproduced as fixtures (ISSUE 8 acceptance)
+
+TRACER_BUG_SRC = textwrap.dedent('''\
+    import threading
+    from collections import deque
+
+
+    class MiniTracer:
+        """The PR-5 SpanTracer bug: comprehension over the LIVE deque
+        while worker threads append raises "deque mutated during
+        iteration"."""
+
+        def __init__(self):
+            self._ring = deque(maxlen=64)
+            self._t = threading.Thread(target=self._worker, daemon=True)
+            self._t.start()
+
+        def _worker(self):
+            while True:
+                self._ring.append({"ph": "X"})
+
+        def spans(self):
+            return [r for r in self._ring if r["ph"] == "X"]  # M-BUG
+''')
+
+
+def test_spantracer_deque_iteration_bug_class_flags(tmp_path):
+    findings = _lint_src(tmp_path, TRACER_BUG_SRC)
+    assert [(f.rule, f.line) for f in findings] \
+        == [("RC003", _line_of(TRACER_BUG_SRC, "M-BUG"))]
+
+
+def test_spantracer_fix_shape_is_clean(tmp_path):
+    fixed = TRACER_BUG_SRC.replace(
+        "[r for r in self._ring if", "[r for r in list(self._ring) if"
+    )
+    assert _lint_src(tmp_path, fixed) == []
+
+
+RMW_BUG_SRC = textwrap.dedent('''\
+    import threading
+
+
+    class AsyncWriter:
+        """The CheckpointManager.consecutive_failures shape: a daemon
+        writer and the driver's flush() both bump an unlocked counter —
+        concurrent bumps lose updates."""
+
+        def __init__(self):
+            self.failures = 0
+
+        def save(self, write):
+            def writer():
+                try:
+                    write()
+                except Exception:
+                    self.failures += 1               # M-RMW-worker
+            threading.Thread(target=writer, daemon=True).start()
+
+        def flush(self):
+            self.failures += 1                       # M-RMW-flush
+''')
+
+
+def test_unlocked_rmw_bug_class_flags_both_sides(tmp_path):
+    findings = _lint_src(tmp_path, RMW_BUG_SRC)
+    got = {(f.rule, f.line) for f in findings}
+    assert got == {
+        ("RC002", _line_of(RMW_BUG_SRC, "M-RMW-worker")),
+        ("RC002", _line_of(RMW_BUG_SRC, "M-RMW-flush")),
+    }
+
+
+# --------------------------------------------------------------------- #
+# the clean fixture: edge cases that must NOT flag
+
+CLEAN_SRC = textwrap.dedent('''\
+    import queue
+    import threading
+
+
+    class SafePipeline:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition()
+            self.count = 0
+            self.items = []
+            self.inbox = queue.Queue()
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                item = self.inbox.get()      # blocking, but no lock held
+                with self._lock:
+                    self.items.append(item)
+                    self.count += 1          # RMW under the lock
+
+        def bump(self):
+            with self._lock:
+                self._bump_locked()
+
+        def _bump_locked(self):
+            self.count += 1                  # lock held via helper
+
+        def snapshot(self):
+            return [x for x in list(self.items)]   # list() snapshot
+
+        def wait_ready(self, seq):
+            with self._cv:
+                self._cv.wait_for(lambda: True)    # wait on HELD cv
+
+
+    class Unthreaded:
+        """Same-named attribute, no thread roots: never shared."""
+
+        def __init__(self):
+            self.count = 0
+            self.items = []
+
+        def bump(self):
+            self.count += 1
+
+        def walk(self):
+            return [x for x in self.items]
+''')
+
+
+def test_clean_fixture_produces_zero_findings(tmp_path):
+    findings = _lint_src(tmp_path, CLEAN_SRC)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_public_helper_gets_no_lock_floor(tmp_path):
+    # The helper discipline is for PRIVATE helpers only: a public method
+    # called under a lock somewhere may still be called bare by external
+    # code, so its unlocked shared write stays flagged.
+    src = CLEAN_SRC.replace("_bump_locked", "bump_locked")
+    findings = _lint_src(tmp_path, src)
+    assert {(f.rule, f.line) for f in findings} \
+        == {("RC002", _line_of(src, "lock held via helper"))}
+
+
+# --------------------------------------------------------------------- #
+# suppression
+
+def test_suppression_silences_one_rule(tmp_path):
+    src = RACY_SRC.replace(
+        "self.count += 1                          # M-RC002",
+        "self.count += 1  # graphlint: disable=RC002",
+    )
+    findings = _lint_src(tmp_path, src)
+    rules_lines = {(f.rule, f.line) for f in findings}
+    assert ("RC002", _line_of(src, "disable=RC002")) not in rules_lines
+    assert any(r == "RC001" for r, _ in rules_lines)  # others survive
+
+
+def test_suppression_all_and_wrong_rule(tmp_path):
+    src = RACY_SRC.replace(
+        "self.count = 0                           # M-RC001",
+        "self.count = 0  # graphlint: disable=all",
+    )
+    findings = _lint_src(tmp_path, src)
+    assert not any(f.rule == "RC001" for f in findings)
+    # a suppression naming a DIFFERENT rule does not silence the line
+    src2 = RACY_SRC.replace(
+        "self.count = 0                           # M-RC001",
+        "self.count = 0  # graphlint: disable=RC006",
+    )
+    findings2 = _lint_src(tmp_path, src2)
+    assert any(f.rule == "RC001" for f in findings2)
+
+
+# --------------------------------------------------------------------- #
+# protocol invariants (coordination.py)
+
+def test_invariants_clean_on_repo_tip():
+    findings = racecheck.check_invariants(COORDINATION)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+_PI_SEEDS = {
+    "PI001": (
+        "\n\ndef _rogue_commit(coord, epoch, position):\n"
+        "    return coord.store.commit(epoch, position, 1)\n"
+    ),
+    "PI002": (
+        "\n\ndef _rogue_epoch(self):\n"
+        "    self._next_epoch = 7\n"
+    ),
+    "PI003": (
+        "\n\ndef _rogue_intent(store, epoch, host, position):\n"
+        "    store.write_intent(epoch, host, position)\n"
+    ),
+    "PI004": (
+        "\n\ndef _rogue_beat(board):\n"
+        "    write_json_atomic(board._path(board.host), {})\n"
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_PI_SEEDS))
+def test_seeded_invariant_violation_turns_exit_nonzero(tmp_path, rule,
+                                                       capsys):
+    """ISSUE 8 acceptance: seeding any single protocol-invariant
+    violation into a scratch copy of coordination.py flips the racecheck
+    exit code non-zero."""
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    dst = scratch / "coordination.py"
+    shutil.copy(COORDINATION, dst)
+    # the unmodified scratch copy is clean (race rules + invariants)
+    assert analysis_main(["racecheck", str(scratch),
+                          "--root", REPO]) == 0
+    capsys.readouterr()
+    dst.write_text(dst.read_text() + _PI_SEEDS[rule])
+    findings = racecheck.lint_paths(REPO, [str(scratch)])
+    assert [f.rule for f in findings] == [rule], \
+        "\n".join(f.render() for f in findings)
+    assert analysis_main(["racecheck", str(scratch),
+                          "--root", REPO]) == 1
+    out = capsys.readouterr()
+    assert rule in out.out
+
+
+def test_invariant_suppression_honored(tmp_path):
+    scratch = tmp_path / "s"
+    scratch.mkdir()
+    dst = scratch / "coordination.py"
+    shutil.copy(COORDINATION, dst)
+    dst.write_text(
+        dst.read_text()
+        + "\n\ndef _rogue_epoch(self):\n"
+          "    self._next_epoch = 7  # graphlint: disable=PI002\n"
+    )
+    assert racecheck.lint_paths(REPO, [str(scratch)]) == []
+
+
+# --------------------------------------------------------------------- #
+# CLI exit-code contract
+
+def test_cli_racecheck_subcommand_exit_zero_on_tip(capsys):
+    rc = analysis_main(["racecheck", os.path.join(REPO, "gelly_tpu"),
+                        "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "racecheck: 0 finding(s)" in out
+    assert "analysis clean (racecheck)" in out
+
+
+def test_cli_all_prints_per_tool_summary(capsys):
+    rc = analysis_main(["--all", "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for tool in ("abi", "jitlint", "racecheck"):
+        assert f"{tool}: 0 finding(s)" in out
+    assert "analysis clean (abi, jitlint, racecheck)" in out
+
+
+def test_cli_nonzero_and_counts_on_findings(tmp_path, capsys):
+    (tmp_path / "racy.py").write_text(RACY_SRC)
+    rc = analysis_main(["racecheck", str(tmp_path), "--root", REPO])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "RC001" in captured.out
+    assert "racecheck: 7 finding(s)" in captured.err
+
+
+def test_cli_json_format(tmp_path, capsys):
+    (tmp_path / "racy.py").write_text(RACY_SRC)
+    rc = analysis_main(["racecheck", str(tmp_path), "--root", REPO,
+                        "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["ok"] is False
+    assert payload["total"] == payload["tools"]["racecheck"]["count"] == 7
+    f0 = payload["tools"]["racecheck"]["findings"][0]
+    assert {"path", "line", "rule", "message", "hint"} <= set(f0)
+    # clean run: ok true, every tool present under --all
+    rc2 = analysis_main(["--all", "--root", REPO, "--format=json"])
+    payload2 = json.loads(capsys.readouterr().out)
+    assert rc2 == 0 and payload2["ok"] is True
+    assert set(payload2["tools"]) == {"abi", "jitlint", "racecheck"}
+
+
+def test_cli_list_rules_includes_rc_and_pi(capsys):
+    assert analysis_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("RC001", "RC006", "PI001", "PI004", "GL001", "AB001"):
+        assert rid in out

@@ -660,3 +660,111 @@ def test_load_latest_survives_header_meta_damage(tmp_path):
     rewrite(newest, lambda h: h.__setitem__("meta", "garbage"))
     state, pos, _, _ = mgr.load_latest(like=np.int64(0))
     assert pos == 1 and int(state) == 1  # fell back, no raw exception
+
+
+# ---------------------------------------------------------------------- #
+# concurrency regression (racecheck RC002 fix): consecutive_failures is
+# bumped from the async writer daemon AND from flush() on the driver
+
+
+@pytest.mark.racecheck
+def test_checkpoint_failure_accounting_is_exact_under_contention(
+        tmp_path, monkeypatch):
+    """Pre-fix the unlocked ``consecutive_failures += 1`` lost updates
+    when writer-thread failures raced flush()'s timeout accounting —
+    under-counting misses inflates the max_checkpoint_failures budget.
+    Post-fix the count is exact."""
+    import threading as _threading
+
+    from gelly_tpu.engine import resilience as res_mod
+
+    def failing_save(*a, **kw):
+        raise ValueError("disk on fire")  # permanent: no retry sleeps
+
+    monkeypatch.setattr(res_mod, "save_checkpoint", failing_save)
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    n_threads, per_thread = 8, 25
+
+    def hammer():
+        for i in range(per_thread):
+            with pytest.raises(ValueError):
+                mgr._write({}, i, None)
+
+    threads = [_threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert mgr.consecutive_failures == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------- #
+# barrier watchdog budget (ISSUE 8 satellite): the coordination watchdog
+# must budget watchdog_timeout + 2 * barrier_timeout, so the protocol's
+# own missing/dead-host diagnosis always fires before a generic
+# WatchdogTimeout masks it — and a refactor cannot silently shrink the
+# budget below the protocol's own timeout.
+
+
+def _tmp_coordinator(tmp_path, **cfg_kw):
+    from gelly_tpu.engine.coordination import (
+        CoordinationConfig, Coordinator, HostIdentity,
+    )
+
+    cfg_kw.setdefault("lease_thread", False)
+    return Coordinator(str(tmp_path), HostIdentity(0, 1),
+                       CoordinationConfig(**cfg_kw))
+
+
+@pytest.mark.racecheck
+def test_barrier_watchdog_budget_formula(tmp_path):
+    co = _tmp_coordinator(tmp_path / "a", barrier_timeout=7.0)
+    try:
+        r = ResilientRunner(
+            _step, [1], np.int64(0), coordinator=co,
+            config=ResilienceConfig(watchdog_timeout=3.0),
+        )
+        assert r._barrier_watchdog.timeout == 3.0 + 2 * 7.0
+        assert r._barrier_watchdog.timeout > co.config.barrier_timeout
+    finally:
+        co.close()
+    # watchdog disabled -> barrier watchdog disabled too (never a
+    # smaller budget than the plain boundaries)
+    co2 = _tmp_coordinator(tmp_path / "b", barrier_timeout=7.0)
+    try:
+        r2 = ResilientRunner(
+            _step, [1], np.int64(0), coordinator=co2,
+            config=ResilienceConfig(watchdog_timeout=None),
+        )
+        assert r2._barrier_watchdog.timeout is None
+    finally:
+        co2.close()
+    # no coordinator: the barrier watchdog is inert
+    r3 = ResilientRunner(_step, [1], np.int64(0))
+    assert r3._barrier_watchdog.timeout is None
+
+
+def test_barrier_hang_within_budget_survives_the_watchdog(tmp_path):
+    """A FaultPlan hang on the ``barrier`` boundary longer than the
+    plain watchdog_timeout but inside the documented
+    ``watchdog + 2*barrier_timeout`` budget must complete, not raise
+    WatchdogTimeout — the bound is load-bearing, not decorative."""
+    # Control: the plain watchdog WOULD have fired on this hang.
+    with pytest.raises(WatchdogTimeout):
+        Watchdog(0.05).call(lambda: time.sleep(0.2), "control")
+
+    co = _tmp_coordinator(tmp_path, barrier_timeout=0.5, lease_ttl=2.0,
+                          poll_s=0.005)
+    plan = faults.FaultPlan(
+        [faults.Fault("barrier", at=0, kind="hang", hang_seconds=0.2)]
+    )
+    with faults.install(plan):
+        r = ResilientRunner(
+            _step, [1, 2, 3, 4], np.int64(0), coordinator=co,
+            config=ResilienceConfig(checkpoint_every_chunks=2,
+                                    watchdog_timeout=0.05),
+        )
+        final = r.run()
+    assert ("barrier", 0, "hang") in plan.fired
+    assert int(final) == ((((0 * 3 + 1) * 3 + 2) * 3 + 3) * 3 + 4)
+    assert r.stats["checkpoints"] >= 1

@@ -608,3 +608,45 @@ def test_restartable_prefetch_respects_should_restart():
                               should_restart=lambda e: False)
     with pytest.raises(ValueError, match="permanent"):
         list(it)
+
+
+@pytest.mark.racecheck
+def test_stage_timer_report_concurrent_with_new_stages():
+    """Regression (racecheck RC003 class): report() used to iterate the
+    LIVE totals dict — a prefetch worker booking its first sample into a
+    NEW stage mid-report raised "dictionary changed size during
+    iteration". The snapshot-under-lock fix must survive a hammering."""
+    import threading
+
+    timer = StageTimer()
+    stop = threading.Event()
+    errs = []
+
+    def worker(wid):
+        i = 0
+        try:
+            while not stop.is_set():
+                # i cycles so the stage set keeps gaining NEW names (the
+                # mid-iteration insert the bug needs) without growing
+                # unboundedly — report() stays O(stages) per call.
+                with timer(f"stage-{wid}-{i % 64}"):
+                    pass
+                i += 1
+        except BaseException as e:  # pragma: no cover - the regression
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            rep = timer.report()
+            for row in rep.values():
+                assert row["calls"] >= 1  # totals/counts never skewed
+            timer.busy()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert errs == []
