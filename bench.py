@@ -2356,12 +2356,202 @@ def bench_sharded_state() -> dict:
     }
 
 
+def bench_ingest(args) -> dict:
+    """The ``gelly_tpu.ingest`` workload block (ISSUE 9): (a) the
+    sharded-reader S-sweep — per-reader-lane parse+compress eps over a
+    binary edge file, with the trace-backed serialization check (zero
+    ``produce`` spans, one compress track per lane, max-lane busy vs
+    wall) — and (b) loopback-socket server/client eps speaking the
+    compressed-pair wire format, plus a backpressure pass with a tiny
+    high-water mark proving the staged depth stays bounded.
+
+    Schema (committed reduced CPU captures are structural stand-ins;
+    eps claims cite TPU-host runs):
+
+    - ``sharded_readers.S<k>``: ``{eps, wall_s, lanes, compress_tracks,
+      produce_spans, lane_busy_max_s, lane_busy_sum_s,
+      serialized_frac}`` — ``serialized_frac`` = wall / lane-busy-sum;
+      a single produce loop pins it near 1.0, independent lanes push it
+      toward 1/S.
+    - ``sharded_readers.eps_scaling_s4_vs_s1``: headline ratio.
+    - ``socket_ingest``: ``{eps, wall_s, chunks, wire_bytes_per_edge,
+      backpressure: {engagements, max_staged_depth, high_water,
+      bounded}}``.
+    """
+    import os
+    import tempfile
+    import threading
+
+    from gelly_tpu import obs
+    from gelly_tpu.engine.aggregation import available_cores
+    from gelly_tpu.ingest import (
+        IngestClient,
+        IngestServer,
+        ShardedEdgeSource,
+        write_binary_edges,
+    )
+    from gelly_tpu.library.connected_components import connected_components
+    from gelly_tpu.obs import bus as obs_bus
+
+    n_e = min(args.edges, 1 << 21)
+    n_v = min(args.vertices, 1 << 17)
+    chunk = min(args.chunk_size, 1 << 14)
+    src, dst = synth_edges(n_e, n_v)
+    agg = connected_components(n_v, codec="sparse")
+
+    out: dict = {"metric": "ingest", "edges": n_e, "vertices": n_v,
+                 "chunk_size": chunk, "unit": "edges/sec"}
+    tmp = tempfile.mkdtemp(prefix="gelly-ingest-bench-")
+    path = os.path.join(tmp, "edges.bin")
+    write_binary_edges(path, src, dst)
+
+    # ---------------------------------------------------- reader sweep
+    sweep: dict = {}
+    best_trace = None
+    eps_by_s: dict = {}
+    for S in (1, 2, 4):
+        source = ShardedEdgeSource(path, shards=S, chunk_size=chunk,
+                                   vertex_capacity=n_v)
+        tracer = obs.SpanTracer(capacity=1 << 16, heartbeat_every_s=None)
+
+        def stage(unit, _tr=tracer):
+            seq, group = unit
+            t0 = _tr.now()
+            payload = agg.host_compress(group[0])
+            _tr.span("compress",
+                     f"compress/{threading.current_thread().name}",
+                     t0, unit=seq)
+            return payload
+
+        with obs.scope(), obs.install(tracer):
+            t0 = time.perf_counter()
+            n_units = sum(1 for _ in source.stage_units(
+                stage, batch=1, depth=2 * S))
+            wall = time.perf_counter() - t0
+        spans = tracer.spans("compress")
+        busy: dict = {}
+        for s in spans:
+            busy[s["track"]] = busy.get(s["track"], 0.0) + s["dur"]
+        busy_sum = sum(busy.values())
+        eps_by_s[S] = n_e / wall
+        sweep[f"S{S}"] = {
+            "eps": round(n_e / wall, 1),
+            "wall_s": round(wall, 4),
+            "units": n_units,
+            "lanes": S,
+            "compress_tracks": len(busy),
+            "produce_spans": len(tracer.spans("produce")),
+            "lane_busy_max_s": round(max(busy.values(), default=0.0), 4),
+            "lane_busy_sum_s": round(busy_sum, 4),
+            # 1.0 = fully serialized (one lane's busy IS the wall);
+            # 1/S = perfect lane independence.
+            "serialized_frac": round(wall / max(busy_sum, 1e-9), 4),
+        }
+        if S == 4:
+            best_trace = tracer
+    sweep["eps_scaling_s4_vs_s1"] = round(eps_by_s[4] / eps_by_s[1], 2)
+    sweep["per_lane_tracks_ok"] = bool(
+        sweep["S4"]["compress_tracks"] == 4
+        and sweep["S4"]["produce_spans"] == 0
+    )
+    # Self-describing scaling context (codec_workers_block precedent):
+    # on a 1-core host the lanes physically serialize — the structural
+    # claims (per-lane tracks, no produce span, bounded backpressure)
+    # still hold and are asserted; the eps-scales-with-S claim is a
+    # multi-core/TPU-host capture.
+    cores = available_cores()
+    sweep["available_cores"] = cores
+    sweep["scaling_measurable"] = bool(cores >= 2)
+    if cores < 2:
+        sweep["skipped_reason"] = (
+            "single-core host: S reader lanes time-slice one core, so "
+            "eps cannot scale here; per-lane independence is proven "
+            "structurally (compress_tracks == S, produce_spans == 0)"
+        )
+    out["sharded_readers"] = sweep
+    if best_trace is not None:
+        tpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "trace_ingest_sharded.json")
+        trace = obs.write_chrome_trace(
+            tpath, best_trace, extra={"workload": "ingest_sharded_s4"},
+        )
+        out["trace_file"] = os.path.basename(tpath)
+        out["trace_events"] = len(trace["traceEvents"])
+
+    # ------------------------------------------------- loopback socket
+    sock_chunk = 4096
+    payloads = [
+        agg.host_compress(c)
+        for c in ShardedEdgeSource(path, shards=1, chunk_size=sock_chunk,
+                                   vertex_capacity=n_v)
+    ]
+    wire_edges = n_e
+
+    def run_socket(high_water, low_water, consumer_sleep):
+        with obs_bus.scope() as bus:
+            kw = {"queue_depth": 64}
+            if high_water is not None:
+                kw.update(high_water=high_water, low_water=low_water,
+                          pause_poll_s=0.002)
+            max_depth = 0
+            done = threading.Event()
+
+            def consume(srv):
+                nonlocal max_depth
+                for _seq, _p in srv.payloads():
+                    d = bus.gauges.get("ingest.staged_depth", 0)
+                    if d > max_depth:
+                        max_depth = d
+                    if consumer_sleep:
+                        time.sleep(consumer_sleep)
+                done.set()
+
+            with IngestServer(**kw) as srv:
+                t = threading.Thread(target=consume, args=(srv,),
+                                     daemon=True)
+                t.start()
+                cli = IngestClient("127.0.0.1", srv.port,
+                                   send_pause_timeout=120)
+                cli.connect()
+                t0 = time.perf_counter()
+                for p in payloads:
+                    cli.send(p)
+                cli.flush(timeout=300)
+                wall = time.perf_counter() - t0
+                cli.close()
+            done.wait(timeout=30)
+            snap = bus.snapshot()["counters"]
+            return wall, max_depth, snap
+
+    wall, _depth, snap = run_socket(None, None, 0.0)
+    out["socket_ingest"] = {
+        "eps": round(wire_edges / wall, 1),
+        "wall_s": round(wall, 4),
+        "chunks": len(payloads),
+        "wire_bytes_per_edge": round(
+            snap.get("ingest.bytes_received", 0) / wire_edges, 4
+        ),
+        "frames_rejected": int(snap.get("ingest.frames_rejected", 0)),
+    }
+    hw = 2
+    _wall, max_depth, snap = run_socket(hw, 1, 0.0005)
+    out["socket_ingest"]["backpressure"] = {
+        "high_water": hw,
+        "engagements": int(snap.get("ingest.backpressure_engaged", 0)),
+        "pauses_received": int(snap.get("ingest.pauses_received", 0)),
+        "max_staged_depth": int(max_depth),
+        "bounded": bool(max_depth <= hw),
+    }
+    out["value"] = out["socket_ingest"]["eps"]
+    return out
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--workload", default="all",
                    choices=["all", "cc", "cc_large", "degrees", "triangles",
                             "bipartiteness", "matching", "spanner", "codec",
-                            "gather"])
+                            "gather", "ingest"])
     # K-points for the subprocess codec-scaling sweep (codec_workers_eps):
     # comma list; oversubscribed K on small hosts is fine (the points then
     # bound, rather than exhibit, scaling).
@@ -2408,6 +2598,10 @@ def main() -> int:
                 ks=tuple(int(k) for k in args.codec_workers.split(",")),
             ),
         })
+        write_bench_artifact(args.workload)
+        return 0
+    if args.workload == "ingest":
+        emit(bench_ingest(args))
         write_bench_artifact(args.workload)
         return 0
     if args.workload == "spanner":
@@ -2468,6 +2662,7 @@ def main() -> int:
                 emit({"metric": name, "error": f"{type(e).__name__}: {e}"})
         for name, heavy in (
             ("spanner_device", lambda: bench_spanner(args)),
+            ("ingest", lambda: bench_ingest(args)),
             ("streaming_cc_throughput", lambda: bench_cc(args)),
             ("sharded_state_cc", bench_sharded_state),
             ("streaming_cc_large", lambda: bench_cc_large(args)),

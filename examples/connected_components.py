@@ -2,8 +2,25 @@
 
 Usage: python examples/connected_components.py [--checkpoint-dir=DIR]
            [--codec-workers=K] [--h2d-depth=D] [--merge-mode=MODE]
-           [--trace-out=PATH] [<edges path> <merge every chunks>]
+           [--trace-out=PATH] [--shards=S]
+           [--serve=PORT | --connect=HOST:PORT]
+           [<edges path> <merge every chunks>]
 Prints (vertex, component) pairs after each merge window.
+
+``--shards=S`` reads the edge file through S sharded byte-range reader
+lanes (``gelly_tpu.ingest``): each lane parses AND compresses its own
+range on its own thread — no global produce loop (README "Ingestion").
+Requires an edge file with identity ids; with ``--trace-out`` the
+capture shows one ``compress/gelly-reader_<s>`` track per lane.
+
+``--serve=PORT`` turns this process into the ingestion server: edges
+arrive over the wire protocol (length-prefixed CRC-checked frames) from
+a ``--connect`` peer, are folded as they stream in, and components
+print when the client closes the stream. ``--connect=HOST:PORT``
+instead STREAMS the edge file (or the default data) to such a server
+and prints the acked frame count. Backpressure (PAUSE/RESUME at the
+staged-depth high-water mark) and reconnect-at-acked-seq resume are
+exercised for free — see README "Ingestion" for the contract.
 
 ``--trace-out=PATH`` installs a span tracer (``gelly_tpu.obs``) around
 the run and writes a Chrome-trace JSON to PATH afterwards — open it in
@@ -37,12 +54,54 @@ from gelly_tpu.library.connected_components import (
 )
 
 
+def _serve_stream(port, vertex_capacity=1 << 16, chunk_capacity=4096):
+    """An EdgeStream fed by the wire: raw-edge payloads from a
+    ``--connect`` peer become padded identity chunks."""
+    from gelly_tpu import EdgeStream, IdentityVertexTable, StreamContext
+    from gelly_tpu.ingest import IngestServer
+
+    server = IngestServer(port=port, stop_on_bye=True).start()
+    print(f"# ingest server on port {server.port}; waiting for a "
+          "--connect peer (stream ends at the client's BYE)")
+    ctx = StreamContext(table=IdentityVertexTable(vertex_capacity),
+                        vertex_capacity=vertex_capacity)
+    chunks = lambda: server.chunks(chunk_capacity,  # noqa: E731
+                                   vertex_capacity=vertex_capacity)
+    return EdgeStream(chunks, ctx), server
+
+
+def _connect_main(target, rest):
+    """Stream the edge file (or the default data) to a --serve peer."""
+    import numpy as np
+
+    from gelly_tpu.ingest import IngestClient
+
+    host, port = target.rsplit(":", 1)
+    if rest:
+        from gelly_tpu.core.io import read_edge_list
+
+        src, dst, _ = read_edge_list(rest[0])
+    else:
+        edges = sequence_default_edges()
+        src = np.asarray([e[0] for e in edges], dtype=np.int64)
+        dst = np.asarray([e[1] for e in edges], dtype=np.int64)
+    cli = IngestClient(host, int(port)).connect()
+    frames = cli.send_edges(src, dst)
+    cli.flush(timeout=60)
+    cli.close()  # BYE ends the server's stream
+    print(f"# streamed {src.shape[0]} edges in {frames} CRC-checked "
+          f"frames; server acked {cli.acked}")
+
+
 def main(args):
     ckpt_dir = None
     codec_workers = None
     h2d_depth = None
     merge_mode = "auto"
     trace_out = None
+    shards = None
+    serve = None
+    connect = None
     rest = []
     for a in args:
         if a.startswith("--checkpoint-dir="):
@@ -55,6 +114,12 @@ def main(args):
             merge_mode = a.split("=", 1)[1]
         elif a.startswith("--trace-out="):
             trace_out = a.split("=", 1)[1]
+        elif a.startswith("--shards="):
+            shards = int(a.split("=", 1)[1])
+        elif a.startswith("--serve="):
+            serve = int(a.split("=", 1)[1])
+        elif a.startswith("--connect="):
+            connect = a.split("=", 1)[1]
         else:
             rest.append(a)
     if ckpt_dir is not None and (
@@ -68,7 +133,34 @@ def main(args):
             "pipeline or merge windows — drop the executor knobs or the "
             "checkpoint dir"
         )
-    stream = stream_from_args(rest, default_edges=sequence_default_edges())
+    if sum(x is not None for x in (serve, connect)) > 1:
+        raise SystemExit("--serve and --connect are mutually exclusive")
+    if connect is not None:
+        return _connect_main(connect, rest)
+    if serve is not None and (ckpt_dir is not None or shards is not None):
+        raise SystemExit(
+            "--serve ingests from the wire — it cannot also read a "
+            "sharded file (--shards) or run the checkpoint driver"
+        )
+    if shards is not None and ckpt_dir is not None:
+        raise SystemExit(
+            "--shards uses the pipelined executor's sharded source "
+            "provider; drop --checkpoint-dir (use aggregate-path "
+            "checkpoint_path resume instead)"
+        )
+    if serve is not None:
+        stream, server = _serve_stream(serve)
+    elif shards is not None:
+        if not rest:
+            raise SystemExit("--shards needs an edge file path argument")
+        from gelly_tpu.ingest import edge_stream_from_sharded_file
+
+        stream = edge_stream_from_sharded_file(
+            rest[0], vertex_capacity=1 << 16, shards=shards,
+        )
+    else:
+        stream = stream_from_args(rest,
+                                  default_edges=sequence_default_edges())
     merge_every = arg(rest, 1, 4)
     agg = connected_components(stream.ctx.vertex_capacity,
                                merge_mode=merge_mode)
@@ -78,10 +170,15 @@ def main(args):
             result = stream.aggregate(
                 agg, merge_every=merge_every,
                 codec_workers=codec_workers, h2d_depth=h2d_depth,
+                source_provider=True if shards is not None else None,
             )
             labels = None
-            for labels in result:
-                pass  # continuously-improving summaries; print the final
+            try:
+                for labels in result:
+                    pass  # continuously-improving; print the final
+            finally:
+                if serve is not None:
+                    server.stop()
             return labels
         # The resilient driver runs the RAW jitted fold per chunk — no
         # ingest codec / merge windows — which is correct for this dense

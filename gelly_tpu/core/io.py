@@ -144,6 +144,14 @@ class EdgeChunkSource:
                 raise ValueError("EVENT time requires timestamps or ts_fn")
         else:
             self.timestamps = np.arange(n, dtype=np.int64)
+        # Resume-seek bookkeeping: the edge index the stateful table has
+        # been warmed through (every id below it is already encoded, in
+        # stream order). iter_from() consults it so a resume never
+        # re-encodes a prefix this source object already pushed through
+        # the table — the array-source analog of the sharded readers'
+        # recorded per-chunk byte offsets (O(1) seek instead of
+        # O(position) re-read).
+        self._encoded_upto = 0
 
     @property
     def num_edges(self) -> int:
@@ -166,7 +174,12 @@ class EdgeChunkSource:
         assignment, and hence every downstream summary, stays bit-identical
         to an uninterrupted run. Re-encoding already-known ids is idempotent,
         so restarting a partially-consumed source is safe too. Identity
-        tables seek in O(1).
+        tables seek in O(1) — and so does any resume over a prefix this
+        source object already encoded: the first pass records its
+        encoded-through watermark, so the in-process retry/restart path
+        (``restartable_prefetch``, the resilient driver) skips the warm
+        loop entirely instead of paying an O(position) re-encode per
+        restart.
         """
         if chunk_index < 0:
             raise ValueError(f"chunk_index must be >= 0, got {chunk_index}")
@@ -184,10 +197,19 @@ class EdgeChunkSource:
             src_all = self.table.encode(self.src_raw)
             dst_all = self.table.encode(self.dst_raw)
         else:
-            for lo in range(0, start, cs):
+            # Warm only the part of the prefix the table has NOT already
+            # seen from this source (``_encoded_upto`` = recorded resume
+            # position): a resume at-or-below the watermark re-encodes
+            # nothing. Encoding is idempotent for known ids, so a
+            # watermark that lags (first pass stopped early) just means
+            # the remainder of the prefix is encoded here, exactly as a
+            # from-zero run would have.
+            for lo in range(min(self._encoded_upto, start), start, cs):
                 hi = min(lo + cs, n)
                 self.table.encode(self.src_raw[lo:hi])
                 self.table.encode(self.dst_raw[lo:hi])
+            if start > self._encoded_upto:
+                self._encoded_upto = start
         for lo in range(start, n, cs):
             hi = min(lo + cs, n)
             if src_all is not None:
@@ -196,6 +218,8 @@ class EdgeChunkSource:
             else:
                 src = self.table.encode(self.src_raw[lo:hi])
                 dst = self.table.encode(self.dst_raw[lo:hi])
+                if hi > self._encoded_upto:
+                    self._encoded_upto = hi
             yield make_chunk(
                 src,
                 dst,

@@ -655,6 +655,7 @@ def run_aggregation(
     h2d_depth: int | None = None,
     allowed_lateness: int = 0,
     timer=None,
+    source_provider=None,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
 
@@ -732,6 +733,21 @@ def run_aggregation(
     (units/chunks folded, windows closed, checkpoint bytes) land on
     ``obs.get_bus()`` either way.
 
+    **Sharded source readers** (``source_provider``): pass a
+    ``gelly_tpu.ingest.ShardedEdgeSource`` (or ``True`` to use
+    ``stream.source``) and the produce-compress leg is replaced
+    entirely — S reader lanes each parse their own byte range of the
+    edge file AND run the compress stage on their own thread, handing
+    COMPLETED units to the H2D/fold stages in the provider's
+    deterministic merge order. There is no shared produce iterator
+    left: a trace capture shows one ``compress/gelly-reader_<s>`` track
+    per lane instead of a serial produce span train. Provider mode is
+    merge_every-only (sharded ranges carry no global arrival order) and
+    refuses ordered stackers (``stack_ordered`` codecs assign ids in
+    global stream order, which sharded lanes cannot provide). Resume
+    composes with the last-retired-chunk rule below: the provider maps
+    the single recorded position onto per-shard seek offsets.
+
     **Exactly-once resume — the last-retired-chunk rule**: the recorded
     checkpoint position counts only chunks whose fold was *dispatched*
     (retired from the pipeline); units still in the compress/H2D double
@@ -758,6 +774,44 @@ def run_aggregation(
                 f"merge_degree must be a positive power of two, got {d}"
             )
 
+    if source_provider is True:
+        source_provider = getattr(stream, "source", None)
+        if source_provider is None:
+            raise ValueError(
+                "source_provider=True needs a stream whose .source is a "
+                "sharded provider (edge_stream_from_sharded_file); this "
+                "stream has none"
+            )
+    if source_provider is not None:
+        if not hasattr(source_provider, "stage_units"):
+            raise ValueError(
+                f"source_provider {type(source_provider).__name__} does "
+                "not implement stage_units(stage_fn, batch, start, depth, "
+                "cancel, gauge) — pass a gelly_tpu.ingest."
+                "ShardedEdgeSource or an object with that protocol"
+            )
+        if window_ms is not None:
+            raise ValueError(
+                "source_provider is merge_every-only: sharded reader "
+                "lanes have no global arrival order, so timestamp-"
+                "tumbling windows cannot be formed from them"
+            )
+        if agg.stack_ordered:
+            raise ValueError(
+                f"aggregation '{agg.name}' uses an ordered stacker "
+                "(stack_ordered codec session assigning ids in global "
+                "stream order); sharded reader lanes compress "
+                "concurrently with no global order — use the "
+                "single-iterator path or a stateless codec"
+            )
+        if codec_workers is not None or ingest_workers is not None:
+            raise ValueError(
+                "codec_workers/ingest_workers size the prefetch_map "
+                "compress pool, which a source_provider replaces "
+                "entirely — the provider's shard count IS the lane "
+                "count (e.g. ShardedEdgeSource(shards=...)); drop the "
+                "worker knob or the provider"
+            )
     if codec_workers is not None:
         if ingest_workers is not None:
             raise ValueError(
@@ -1412,11 +1466,23 @@ def run_aggregation(
                     "pipeline.staged_depth", d)
                 h2d_gauge = lambda d: bus.gauge(  # noqa: E731
                     "pipeline.h2d_depth", d)
-            staged = prefetch_map(
-                stage_unit, produced_units(), depth=prefetch_depth,
-                workers=ingest_workers, cancel=pipe_cancel,
-                gauge=staged_gauge,
-            )
+            if source_provider is not None:
+                # Sharded reader lanes: parse + compress run per-lane on
+                # the provider's threads; the engine's stage closure is
+                # handed over so codec/batch/precombine semantics (and
+                # the compress spans, now on gelly-reader_<s> tracks)
+                # stay identical to the single-iterator path.
+                staged = source_provider.stage_units(
+                    stage_unit, batch=batch, start=skip_until,
+                    depth=prefetch_depth, cancel=pipe_cancel,
+                    gauge=staged_gauge,
+                )
+            else:
+                staged = prefetch_map(
+                    stage_unit, produced_units(), depth=prefetch_depth,
+                    workers=ingest_workers, cancel=pipe_cancel,
+                    gauge=staged_gauge,
+                )
             transferred = map(h2d_unit, staged)
             if h2d_depth > 0:
                 transferred = prefetch(transferred, depth=h2d_depth,

@@ -867,7 +867,8 @@ class Coordinator:
 
     # -------------------------------------------------------- recover
 
-    def recover(self, like=None, adopt: Callable | None = None):
+    def recover(self, like=None, adopt: Callable | None = None,
+                reshard: Callable | None = None):
         """Restart-time re-join. Returns ``None`` (fresh store) or
         ``(state, position, meta)``:
 
@@ -879,8 +880,11 @@ class Coordinator:
           the degradation rung — this survivor additionally loads every
           orphan shard assigned to it (``old_host % new_count``) and
           folds each into its state with ``adopt(state, shard_state)``;
-          publishes ``coordination.degradations``. The caller re-routes
-          the lost hosts' future chunks (ingest-side re-shard).
+          publishes ``coordination.degradations``. ``reshard`` — the
+          ingest-side re-shard hook (``gelly_tpu.ingest.
+          ShardRoutingTable.reroute`` fits it) — is then called with
+          ``(old_count, new_count)`` so the lost hosts' future chunks
+          follow their adopted state to the same survivors.
         - committed ``process_count`` > ours without ``adopt``: loud
           :class:`CoordinationError` — silently dropping shards would
           lose folded edges.
@@ -950,6 +954,11 @@ class Coordinator:
                 "(adopted shards %s); stream continues at %.0f%% capacity",
                 me, n, old_n, adopted, 100.0 * n / old_n,
             )
+            if reshard is not None:
+                # Ingest follows state: re-route the lost hosts' reader
+                # shards to the survivors that adopted their forests
+                # (same j % new_count rule on both sides).
+                reshard(old_n, n)
         bus.emit(
             "coordination.rejoins", epoch=epoch, position=position,
             host=me, degraded=bool(adopted),

@@ -9,6 +9,12 @@ bindings (``utils/native.py``) call :func:`inject`:
 
 - ``"native"``            — entry of a ctypes call into a native library
 - ``"codec"``             — a codec worker staging a unit (host compress)
+- ``"ingest"``            — the ingest subsystem's own boundaries
+                            (``gelly_tpu/ingest/``): a sharded reader
+                            lane about to parse a chunk, the server's
+                            per-frame receive path, and the client's
+                            send path — so the seeded fault matrix
+                            drives reader and socket failures too
 - ``"h2d"``               — host→device staging of a chunk
 - ``"step"``              — the jitted ``step(state, chunk)`` dispatch
 - ``"source"``            — the chunk source / prefetch worker
@@ -49,6 +55,7 @@ from typing import Callable, Iterator, Sequence
 BOUNDARIES = (
     "native",
     "codec",
+    "ingest",
     "h2d",
     "step",
     "source",

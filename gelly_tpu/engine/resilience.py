@@ -510,6 +510,7 @@ class ResilientRunner:
         coordinator=None,
         flatten_state: Callable[[Any], Any] | None = None,
         adopt_state: Callable[[Any, Any], Any] | None = None,
+        reshard_source: Callable[[int, int], Any] | None = None,
     ):
         self._step = step
         self._make_iter = _make_seekable(chunks)
@@ -525,6 +526,11 @@ class ResilientRunner:
         self._degraded = False
         self._flatten = flatten_state
         self._adopt = adopt_state
+        # Ingest-side re-shard hook for the coordinated degraded re-join
+        # (``gelly_tpu.ingest.ShardRoutingTable.reroute`` fits it): when
+        # recover() adopts a lost host's state shards, this reroutes the
+        # lost host's READER shards to the same survivors.
+        self._reshard_source = reshard_source
         self.coordinator = coordinator
         if coordinator is not None and checkpoint_dir is not None:
             raise ValueError(
@@ -641,7 +647,8 @@ class ResilientRunner:
         if self.coordinator is not None and self._resume:
             found = self._barrier_watchdog.call(
                 lambda: self.coordinator.recover(
-                    like=state, adopt=self._adopt
+                    like=state, adopt=self._adopt,
+                    reshard=self._reshard_source,
                 ),
                 "barrier",
             )

@@ -1,0 +1,373 @@
+"""Ingest client: resumable compressed-chunk streaming over the wire.
+
+The sending half of ``ingest/server.py``'s delivery contract:
+
+- every DATA frame carries the next sequence number and stays in the
+  **resend buffer** until the server acks past it (acks follow the
+  server's durability point, so the buffer is exactly the chunks a
+  server crash could lose);
+- a **reconnect** re-handshakes (HELLO → WELCOME) and rewinds to the
+  server's expected seq, retransmitting the buffered suffix — the
+  client-side half of "a SIGKILLed server restarts without
+  double-folding acked chunks";
+- **PAUSE/RESUME** frames gate :meth:`send` (gauge-driven
+  backpressure); REJECT frames rewind and retransmit in place.
+
+A background reader thread (``gelly-ingest-client-rx``) owns every
+incoming frame; protocol state is lock-guarded and ack progress is
+signalled through a condition variable (:meth:`flush` waits on it).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Iterable
+
+import numpy as np
+
+from ..engine import faults as faults_mod
+from ..obs import bus as obs_bus
+from . import wire
+
+logger = logging.getLogger("gelly_tpu.ingest")
+
+
+def edge_payload(src, dst) -> dict:
+    """The raw-edge DATA payload (``ingest/server.payload_to_chunk``'s
+    inverse): one frame per chunk of (src, dst) pairs."""
+    return {
+        "src": np.asarray(src, dtype=np.int64),
+        "dst": np.asarray(dst, dtype=np.int64),
+    }
+
+
+class IngestError(RuntimeError):
+    """Client-side protocol failure (timeout, unresumable state)."""
+
+
+class IngestClient:
+    """One resumable ingest stream to an :class:`IngestServer`.
+
+    ``connect()`` handshakes and starts the reader thread; ``send()``
+    frames one payload dict; ``flush()`` blocks until the server has
+    acked everything sent; ``reconnect()`` re-handshakes after a server
+    restart and retransmits the unacked suffix. Single-sender
+    discipline: ``send``/``flush``/``close`` belong to one caller
+    thread (the reader thread only ever retransmits under the send
+    lock).
+    """
+
+    def __init__(self, host: str, port: int, *,
+                 connect_timeout: float = 5.0,
+                 send_pause_timeout: float = 30.0):
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        self.send_pause_timeout = send_pause_timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._send_lock = threading.Lock()
+        # seq -> framed bytes, pruned as acks arrive (insertion order =
+        # seq order, so a rewind replays a contiguous suffix).
+        self._unacked: dict[int, bytes] = {}
+        self._next_seq = 0
+        self._acked = 0
+        self._closed = False
+        self._rx_error: BaseException | None = None
+        # Set = clear to send; PAUSE clears it, RESUME sets it.
+        self._resume_evt = threading.Event()
+        self._resume_evt.set()
+        self._rx_thread: threading.Thread | None = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def connect(self) -> "IngestClient":
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout
+        )
+        sock.settimeout(0.2)
+        with self._lock:
+            self._sock = sock
+            self._closed = False
+            self._rx_error = None
+        # Synchronous handshake BEFORE the reader thread exists: the
+        # WELCOME tells us where the server wants the stream to resume.
+        # Control frames can legitimately interleave (a server already
+        # under backpressure PAUSEs before it reads the HELLO) — absorb
+        # them here the same way the reader loop would.
+        self._raw_send(wire.pack_frame(wire.HELLO, 0))
+        recv = _blocking_recv(sock, self.connect_timeout)
+        while True:
+            ftype, seq, _payload = wire.read_frame(recv)
+            if ftype == wire.WELCOME:
+                break
+            if ftype == wire.PAUSE:
+                self._resume_evt.clear()
+            elif ftype == wire.RESUME:
+                self._resume_evt.set()
+            elif ftype in (wire.ACK, wire.REJECT):
+                continue  # stale from a previous connection epoch
+            else:
+                raise IngestError(
+                    f"expected WELCOME during handshake, got frame "
+                    f"type {ftype}"
+                )
+        # The handshake left _resume_evt reflecting THIS connection's
+        # backpressure state (a dead connection's teardown always sets
+        # it, so no stale PAUSE can leak in from before).
+        self._rewind_to(seq)
+        self._rx_thread = threading.Thread(
+            target=self._reader_loop, args=(sock,), daemon=True,
+            name="gelly-ingest-client-rx",
+        )
+        self._rx_thread.start()
+        return self
+
+    def reconnect(self) -> "IngestClient":
+        """Re-handshake after a dropped connection / server restart and
+        retransmit the unacked suffix from the server's expected seq."""
+        self._teardown_socket()
+        return self.connect()
+
+    def close(self, flush_timeout: float | None = 10.0) -> None:
+        """Flush (when a timeout is given), send BYE, stop the reader.
+        A flush failure still tears the connection down — the unacked
+        frames stay buffered for a later ``reconnect()``."""
+        if flush_timeout is not None:
+            try:
+                self.flush(timeout=flush_timeout)
+            except IngestError as e:
+                logger.warning("close(): flush incomplete (%s)", e)
+        with self._lock:
+            sock = self._sock
+        if sock is not None:
+            try:
+                self._raw_send(wire.pack_frame(wire.BYE, 0))
+            except IngestError:
+                pass
+        self._teardown_socket()
+
+    def __enter__(self):
+        return self.connect()
+
+    def __exit__(self, *exc):
+        self.close(flush_timeout=None)
+
+    # ------------------------------------------------------------ sending
+
+    def send(self, payload: dict) -> int:
+        """Frame + transmit one payload dict; returns its seq. Blocks
+        while the server holds the stream PAUSEd (backpressure)."""
+        faults_mod.inject("ingest")
+        if not self._resume_evt.wait(self.send_pause_timeout):
+            raise IngestError(
+                f"stream PAUSEd longer than {self.send_pause_timeout}s — "
+                "is the consumer stalled past the backpressure window?"
+            )
+        with self._lock:
+            self._raise_rx_error_locked()
+            seq = self._next_seq
+            frame = wire.pack_frame(
+                wire.DATA, seq, wire.pack_payload(payload)
+            )
+            self._unacked[seq] = frame
+            self._next_seq = seq + 1
+        self._raw_send(frame)
+        obs_bus.get_bus().inc("ingest.frames_sent")
+        return seq
+
+    def send_edges(self, src, dst, chunk_size: int = 4096) -> int:
+        """Chunk raw (src, dst) arrays into DATA frames; returns the
+        number of frames sent."""
+        src = np.asarray(src)
+        dst = np.asarray(dst)
+        n = 0
+        for lo in range(0, src.shape[0], chunk_size):
+            self.send(edge_payload(src[lo:lo + chunk_size],
+                                   dst[lo:lo + chunk_size]))
+            n += 1
+        return n
+
+    def send_payloads(self, payloads: Iterable[dict]) -> int:
+        n = 0
+        for p in payloads:
+            self.send(p)
+            n += 1
+        return n
+
+    def flush(self, timeout: float = 30.0) -> int:
+        """Wait until the server has acked every sent frame; returns
+        the acked seq. :class:`IngestError` on timeout."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: (self._acked >= self._next_seq
+                         or self._rx_error is not None),
+                timeout=timeout,
+            )
+            self._raise_rx_error_locked()
+            if not ok:
+                raise IngestError(
+                    f"flush timed out with {len(self._unacked)} frame(s) "
+                    f"unacked (sent {self._next_seq}, acked {self._acked})"
+                )
+            return self._acked
+
+    @property
+    def acked(self) -> int:
+        with self._lock:
+            return self._acked
+
+    @property
+    def unacked_count(self) -> int:
+        with self._lock:
+            return len(self._unacked)
+
+    @property
+    def paused(self) -> bool:
+        return not self._resume_evt.is_set()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _raw_send(self, frame: bytes) -> None:
+        with self._lock:
+            sock = self._sock
+        if sock is None:
+            raise IngestError("not connected")
+        try:
+            with self._send_lock:
+                sock.sendall(frame)
+        except OSError as e:
+            raise IngestError(
+                f"send failed ({e}); reconnect() to resume at the acked "
+                "sequence"
+            ) from e
+
+    def _rewind_to(self, server_next: int) -> None:
+        """Align with the server's expected seq after a (re)connect:
+        prune frames the server already staged, retransmit the rest."""
+        with self._lock:
+            if server_next > self._next_seq:
+                raise IngestError(
+                    f"server expects seq {server_next} but only "
+                    f"{self._next_seq} frames were ever sent — wrong "
+                    "server / stream?"
+                )
+            if server_next < self._acked:
+                raise IngestError(
+                    f"server rewound below the acked position "
+                    f"({server_next} < {self._acked}) — acked state was "
+                    "lost; refusing to guess at consistency"
+                )
+            self._acked = server_next
+            for seq in [s for s in self._unacked if s < server_next]:
+                del self._unacked[seq]
+            replay = [self._unacked[s] for s in sorted(self._unacked)]
+            self._cv.notify_all()
+        for frame in replay:
+            self._raw_send(frame)
+        if replay:
+            obs_bus.get_bus().inc("ingest.frames_resent", len(replay))
+
+    def _reader_loop(self, sock) -> None:
+        bus = obs_bus.get_bus()
+        recv = _poll_recv(sock, lambda: self._closed)
+        try:
+            while True:
+                try:
+                    ftype, seq, _payload = wire.read_frame(recv)
+                except (wire.FrameError, _SocketGone):
+                    return
+                if ftype == wire.ACK:
+                    with self._lock:
+                        if seq > self._acked:
+                            self._acked = seq
+                        for s in [s for s in self._unacked if s < seq]:
+                            del self._unacked[s]
+                        self._cv.notify_all()
+                elif ftype == wire.PAUSE:
+                    bus.inc("ingest.pauses_received")
+                    self._resume_evt.clear()
+                elif ftype == wire.RESUME:
+                    self._resume_evt.set()
+                elif ftype == wire.REJECT:
+                    # Server refused a frame (CRC / gap): rewind to its
+                    # expected seq and retransmit in place.
+                    bus.inc("ingest.rejects_received")
+                    try:
+                        self._rewind_to(seq)
+                    except IngestError as e:
+                        with self._lock:
+                            self._rx_error = e
+                            self._cv.notify_all()
+                        return
+                elif ftype == wire.BYE:
+                    return
+        finally:
+            # Never leave the sender parked on a PAUSE that can no
+            # longer be lifted by this (dead) connection.
+            self._resume_evt.set()
+            with self._lock:
+                self._cv.notify_all()
+
+    def _raise_rx_error_locked(self) -> None:
+        if self._rx_error is not None:
+            raise IngestError(
+                f"reader thread failed: {self._rx_error}"
+            ) from self._rx_error
+
+    def _teardown_socket(self) -> None:
+        with self._lock:
+            sock, self._sock = self._sock, None
+            self._closed = True
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        t = self._rx_thread
+        if t is not None:
+            t.join(timeout=1.0)
+        with self._lock:
+            self._closed = False
+
+
+class _SocketGone(Exception):
+    pass
+
+
+def _blocking_recv(sock, timeout: float):
+    """recv(n) with an overall deadline — handshake use."""
+    import time
+
+    deadline = time.monotonic() + timeout
+
+    def recv(n: int) -> bytes:
+        while True:
+            if time.monotonic() > deadline:
+                raise IngestError("handshake timed out")
+            try:
+                return sock.recv(n)
+            except socket.timeout:
+                continue
+            except OSError:
+                raise _SocketGone()
+
+    return recv
+
+
+def _poll_recv(sock, closed) -> "callable":
+    def recv(n: int) -> bytes:
+        while True:
+            if closed():
+                raise _SocketGone()
+            try:
+                return sock.recv(n)
+            except socket.timeout:
+                continue
+            except OSError:
+                raise _SocketGone()
+
+    return recv

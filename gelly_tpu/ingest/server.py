@@ -1,0 +1,457 @@
+"""The network edge-ingestion front end: a socket server for the wire.
+
+The reference gets live sources for free from Flink
+(``env.socketTextStream`` → ``SimpleEdgeStream``); this server is the
+TPU port's equivalent L0: clients stream compressed chunk payloads
+(``ingest/wire.py`` frames), the server validates CRC + sequence and
+hands them to whatever consumes :meth:`IngestServer.payloads` — the
+engine, a resilient fold loop, a bench harness.
+
+Delivery contract:
+
+- **Per-stream sequence numbers.** One logical stream per server; the
+  expected next sequence survives reconnects (a new connection's
+  WELCOME carries it, and the client rewinds its resend buffer to it).
+  Duplicates (seq below expected, a reconnect replay) are dropped and
+  re-acked; gaps are REJECTed with the expected seq.
+- **CRC per frame** (the checkpoint-layer discipline on the wire): a
+  corrupt payload is REJECTed — ``ingest.frames_rejected`` counts it —
+  and the expected seq does NOT advance; the client retransmits. A
+  torn frame (connection died mid-frame) ends the connection without
+  enqueueing anything.
+- **Acks follow durability, not receipt.** With ``auto_ack=True``
+  (lossy-tolerant pipelines) a frame is acked once enqueued. With
+  ``auto_ack=False`` the CONSUMER calls :meth:`ack` after its own
+  durability point (e.g. after a checkpoint covering the position), so
+  an acked chunk is never re-sent AND never re-folded: a server
+  SIGKILLed after folding-but-before-checkpointing simply never acked
+  those frames, and the restarted incarnation's WELCOME asks the
+  client to resend exactly from the checkpoint position.
+- **Gauge-driven backpressure.** Before each frame read the server
+  checks the staging depth — ``max`` of its own queue and the engine's
+  ``pipeline.staged_depth`` gauge — against ``high_water``; at/above
+  it, a PAUSE frame goes out and the server stops reading the socket
+  (TCP flow control backs the contract even against a client that
+  ignores PAUSE) until the depth drains to ``low_water``, then RESUME.
+  Engagements are published as ``ingest.backpressure_engaged`` events
+  and the ``ingest.paused`` gauge.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Iterator
+
+import numpy as np
+
+from ..engine import faults as faults_mod
+from ..obs import bus as obs_bus
+from ..obs import tracing as obs_tracing
+from . import wire
+
+logger = logging.getLogger("gelly_tpu.ingest")
+
+_DONE = object()
+
+
+def payload_to_chunk(payload: dict, capacity: int,
+                     vertex_capacity: int | None = None):
+    """Convert a raw-edge payload (``{"src": i64[n], "dst": i64[n]}``,
+    the :func:`~gelly_tpu.ingest.client.edge_payload` format) into a
+    padded host EdgeChunk of fixed ``capacity`` (static shapes keep the
+    downstream fold on one compiled program).
+
+    ``vertex_capacity`` bounds the identity id space, matching every
+    file-based ingest path: an out-of-range id raises here instead of
+    silently truncating to int32 and corrupting (or being masked out
+    of) the downstream fold — wire clients are exactly the peers most
+    likely to send ids the summary was not sized for."""
+    from ..core.chunk import make_chunk
+
+    src = np.asarray(payload["src"], dtype=np.int64)
+    dst = np.asarray(payload["dst"], dtype=np.int64)
+    if src.shape[0] > capacity:
+        raise ValueError(
+            f"payload carries {src.shape[0]} edges > chunk capacity "
+            f"{capacity}"
+        )
+    if vertex_capacity is not None and src.shape[0]:
+        hi = int(max(src.max(), dst.max()))
+        lo = int(min(src.min(), dst.min()))
+        if hi >= vertex_capacity or lo < 0:
+            raise ValueError(
+                f"payload vertex id {hi if hi >= vertex_capacity else lo} "
+                f"out of range for vertex_capacity {vertex_capacity} "
+                "(wire ingest uses identity ids; re-encode at the client "
+                "or raise vertex_capacity)"
+            )
+    return make_chunk(
+        src.astype(np.int32), dst.astype(np.int32),
+        raw_src=src, raw_dst=dst, capacity=capacity, device=False,
+    )
+
+
+class IngestServer:
+    """Accepts one resumable ingest stream on a TCP port.
+
+    ``start()`` binds and returns (the accept loop runs on a daemon
+    thread); iterate :meth:`payloads` (or :meth:`chunks`) to consume.
+    ``queue_depth`` bounds staged frames (absolute backstop);
+    ``high_water`` / ``low_water`` drive the PAUSE/RESUME protocol.
+    ``resume_seq`` seeds the expected sequence — a restarted server
+    passes its checkpoint position so acked chunks are never re-folded.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 queue_depth: int = 64, high_water: int | None = None,
+                 low_water: int | None = None, ack_every: int = 1,
+                 auto_ack: bool = True, resume_seq: int = 0,
+                 pause_poll_s: float = 0.005, stop_on_bye: bool = False):
+        self.host = host
+        # One-shot servers (the example's --serve mode): a client BYE
+        # ends the whole stream, so the consumer's iterator terminates.
+        self.stop_on_bye = stop_on_bye
+        self._requested_port = port
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.queue_depth = queue_depth
+        self.high_water = (queue_depth if high_water is None
+                           else int(high_water))
+        if self.high_water < 1:
+            raise ValueError(f"high_water must be >= 1, got {high_water}")
+        self.low_water = (max(0, self.high_water // 2) if low_water is None
+                          else int(low_water))
+        if self.low_water >= self.high_water:
+            raise ValueError(
+                f"low_water {self.low_water} must sit below high_water "
+                f"{self.high_water}"
+            )
+        self.ack_every = max(1, int(ack_every))
+        self.auto_ack = auto_ack
+        self.pause_poll_s = pause_poll_s
+        import queue as queue_mod
+
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=queue_depth)
+        self._stop = threading.Event()
+        # _state_lock guards the protocol counters; _send_lock guards
+        # socket writes (acks go out from BOTH the connection thread
+        # and the consumer's ack() call). Never nested.
+        self._state_lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._next_seq = int(resume_seq)
+        self._acked = int(resume_seq)
+        self._durable = int(resume_seq)
+        self._conn_sock: socket.socket | None = None
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self.port: int | None = None
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> "IngestServer":
+        if self._listener is not None:
+            raise RuntimeError("server already started")
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self.host, self._requested_port))
+        ls.listen(4)
+        ls.settimeout(0.1)
+        with self._state_lock:  # the accept loop reads _listener
+            self._listener = ls
+            self.port = ls.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="gelly-ingest-accept",
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        """End the stream: the consumer's :meth:`payloads` iterator
+        terminates after draining what is already queued."""
+        self._stop.set()
+        with self._state_lock:
+            sock, self._conn_sock = self._conn_sock, None
+        if sock is not None:
+            _close_quietly(sock)
+        if self._listener is not None:
+            _close_quietly(self._listener)
+        # Unblock a parked consumer.
+        try:
+            self._q.put_nowait(_DONE)
+        except Exception:  # queue full: consumer will still see _stop
+            pass
+
+    close = stop
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ----------------------------------------------------------- consumer
+
+    def payloads(self) -> Iterator[tuple[int, dict]]:
+        """Yield ``(seq, payload_dict)`` in sequence order until
+        :meth:`stop`. The bounded staging queue is the backpressure
+        boundary: not consuming stalls the wire, never memory."""
+        import queue as queue_mod
+
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue_mod.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if item is _DONE:
+                return
+            yield item
+
+    def chunks(self, capacity: int,
+               vertex_capacity: int | None = None) -> Iterator:
+        """Raw-edge payload stream as padded EdgeChunks (see
+        :func:`payload_to_chunk`; pass the stream's ``vertex_capacity``
+        so out-of-range wire ids fail loudly, file-ingest parity)."""
+        for _seq, payload in self.payloads():
+            yield payload_to_chunk(payload, capacity, vertex_capacity)
+
+    def ack(self, upto: int) -> None:
+        """Mark every seq < ``upto`` durable (consumer checkpoint
+        covering those chunks committed) and push an ACK to the client.
+        The ``auto_ack=False`` half of the exactly-once contract."""
+        with self._state_lock:
+            if upto <= self._durable:
+                return
+            self._durable = upto
+            self._acked = max(self._acked, upto)
+            sock = self._conn_sock
+        if sock is not None:
+            self._send(sock, wire.pack_frame(wire.ACK, upto))
+            obs_bus.get_bus().inc("ingest.acks_sent")
+
+    @property
+    def next_seq(self) -> int:
+        with self._state_lock:
+            return self._next_seq
+
+    @property
+    def durable_seq(self) -> int:
+        with self._state_lock:
+            return self._durable
+
+    # ------------------------------------------------------------ wire IO
+
+    def _send(self, sock, frame: bytes) -> bool:
+        try:
+            with self._send_lock:
+                sock.sendall(frame)
+            return True
+        except OSError:
+            return False
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed by stop()
+            with self._state_lock:
+                old, self._conn_sock = self._conn_sock, sock
+            if old is not None:
+                # Latest connection wins (a reconnecting client's old
+                # socket may still look open server-side).
+                _close_quietly(old)
+            t = threading.Thread(
+                target=self._conn_loop, args=(sock, addr), daemon=True,
+                name="gelly-ingest-conn",
+            )
+            t.start()
+
+    def _conn_loop(self, sock: socket.socket, addr) -> None:
+        bus = obs_bus.get_bus()
+        tracer = obs_tracing.active_tracer()
+        sock.settimeout(0.2)
+        logger.info("ingest connection from %s", addr)
+        # Batched-ack remainder (ack_every > 1): flushed on BYE and on
+        # every idle recv tick, so a client's flush() never waits past
+        # one socket-timeout quantum for the tail acknowledgement.
+        pending_acks = [0]
+
+        def flush_tail():
+            if self.auto_ack and pending_acks[0]:
+                pending_acks[0] = 0
+                with self._state_lock:
+                    acked = self._acked
+                self._send(sock, wire.pack_frame(wire.ACK, acked))
+                bus.inc("ingest.acks_sent")
+
+        recv = _timeout_recv(sock, self._stop, idle=flush_tail)
+        try:
+            while not self._stop.is_set():
+                try:
+                    ftype, seq, payload, crc_ok = wire.read_frame_checked(
+                        recv
+                    )
+                except wire.TruncatedFrame:
+                    # Torn frame: nothing of it is trusted or enqueued;
+                    # the acked-seq resume makes the tear harmless.
+                    bus.inc("ingest.frames_truncated")
+                    return
+                except wire.FrameError as e:
+                    bus.inc("ingest.frames_rejected")
+                    logger.warning("undecodable frame from %s: %s", addr, e)
+                    return  # no trustworthy frame boundary left
+                except _ConnClosed:
+                    return
+                faults_mod.inject("ingest")
+                bus.inc("ingest.frames_received")
+                bus.inc("ingest.bytes_received",
+                        wire.HEADER_BYTES + len(payload))
+                if not crc_ok:
+                    # The checkpoint CRC discipline on the wire: reject,
+                    # never advance past unverifiable bytes.
+                    bus.inc("ingest.frames_rejected")
+                    if tracer is not None:
+                        tracer.instant("ingest.frame_rejected", seq=seq)
+                    with self._state_lock:
+                        expect = self._next_seq
+                    self._send(sock, wire.pack_frame(wire.REJECT, expect))
+                    continue
+                if ftype == wire.HELLO:
+                    with self._state_lock:
+                        expect = self._next_seq
+                    self._send(sock, wire.pack_frame(wire.WELCOME, expect))
+                    continue
+                if ftype == wire.BYE:
+                    flush_tail()
+                    if self.stop_on_bye:
+                        self.stop()
+                    return
+                if ftype != wire.DATA:
+                    continue  # unexpected control frame: ignore
+                with self._state_lock:
+                    expect = self._next_seq
+                if seq < expect:
+                    # Reconnect replay of an already-staged chunk.
+                    bus.inc("ingest.frames_duplicate")
+                    with self._state_lock:
+                        acked = self._acked
+                    self._send(sock, wire.pack_frame(wire.ACK, acked))
+                    continue
+                if seq > expect:
+                    bus.inc("ingest.frames_rejected")
+                    self._send(sock, wire.pack_frame(wire.REJECT, expect))
+                    continue
+                try:
+                    data = wire.unpack_payload(payload)
+                except wire.FrameError as e:
+                    bus.inc("ingest.frames_rejected")
+                    logger.warning("malformed payload seq=%d: %s", seq, e)
+                    self._send(sock, wire.pack_frame(wire.REJECT, expect))
+                    continue
+                # Admission control sits HERE — at the staging boundary,
+                # after control frames (so a handshake always completes
+                # even under full backpressure) and before the enqueue
+                # (so the staged depth never exceeds the high-water
+                # mark). Frames the client already pushed into kernel
+                # buffers wait there under TCP flow control.
+                self._apply_backpressure(sock, bus)
+                if not self._enqueue((seq, data)):
+                    return  # stopped while staging
+                with self._state_lock:
+                    self._next_seq = seq + 1
+                    if self.auto_ack:
+                        self._acked = seq + 1
+                    acked = self._acked
+                bus.inc("ingest.chunks_enqueued")
+                bus.gauge("ingest.staged_depth", self._q.qsize())
+                if tracer is not None:
+                    tracer.instant("ingest.chunk_staged", track="ingest",
+                                   seq=seq, bytes=len(payload))
+                pending_acks[0] += 1
+                if self.auto_ack and pending_acks[0] >= self.ack_every:
+                    pending_acks[0] = 0
+                    self._send(sock, wire.pack_frame(wire.ACK, acked))
+                    bus.inc("ingest.acks_sent")
+        finally:
+            _close_quietly(sock)
+            with self._state_lock:
+                if self._conn_sock is sock:
+                    self._conn_sock = None
+
+    def _enqueue(self, item) -> bool:
+        import queue as queue_mod
+
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _apply_backpressure(self, sock, bus) -> None:
+        """PAUSE the client while the staging depth sits at/above the
+        high-water mark; RESUME once drained to low_water. Depth is the
+        max of this server's own queue and the engine's
+        ``pipeline.staged_depth`` gauge, so wire admission tracks the
+        whole pipeline, not just the socket-side buffer."""
+        depth = max(self._q.qsize(),
+                    bus.gauges.get("pipeline.staged_depth", 0))
+        if depth < self.high_water:
+            return
+        bus.emit("ingest.backpressure_engaged", depth=depth,
+                 high_water=self.high_water)
+        bus.gauge("ingest.paused", 1)
+        self._send(sock, wire.pack_frame(wire.PAUSE, 0))
+        try:
+            while not self._stop.is_set():
+                depth = max(self._q.qsize(),
+                            bus.gauges.get("pipeline.staged_depth", 0))
+                if depth <= self.low_water:
+                    break
+                time.sleep(self.pause_poll_s)
+        finally:
+            bus.gauge("ingest.paused", 0)
+            self._send(sock, wire.pack_frame(wire.RESUME, 0))
+
+
+class _ConnClosed(Exception):
+    """Internal: the socket closed / the server is stopping."""
+
+
+def _timeout_recv(sock, stop: threading.Event, idle=None):
+    """A ``recv(n)`` that polls the stop event through socket timeouts
+    (the accept/conn threads must die with the server, not block in a
+    bare recv forever). ``idle`` (optional zero-arg callable) runs on
+    each timeout tick — the conn loop uses it to flush batched acks
+    while the wire is quiet."""
+
+    def recv(n: int) -> bytes:
+        while True:
+            if stop.is_set():
+                raise _ConnClosed()
+            try:
+                return sock.recv(n)
+            except socket.timeout:
+                if idle is not None:
+                    idle()
+                continue
+            except OSError:
+                raise _ConnClosed()
+
+    return recv
+
+
+def _close_quietly(sock) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass

@@ -1,0 +1,214 @@
+"""The ingest wire format: length-prefixed, CRC-checked frames.
+
+One frame carries one compressed chunk payload (or a control message).
+The payload body is a self-describing dict-of-ndarrays codec — exactly
+the pytrees the ingest codecs already produce (e.g. the sparse CC
+codec's counted ``{"v": i32[k], "r": i32[k]}`` pairs at ~0.25
+bytes/edge after chunk combining), so the wire carries the SAME bytes
+the H2D leg would, and the server can hand frames straight to the fold
+without re-compressing.
+
+Frame layout (network byte order)::
+
+    magic  u16   0x4749 ("GI")
+    type   u8    HELLO/WELCOME/DATA/ACK/REJECT/PAUSE/RESUME/BYE
+    flags  u8    reserved (0)
+    seq    u64   per-stream sequence number (DATA: the chunk position;
+                 ACK/REJECT/WELCOME: the position being acknowledged /
+                 expected)
+    len    u32   payload byte length
+    crc    u32   zlib.crc32 of the payload bytes
+
+The CRC discipline is the checkpoint layer's (``engine/checkpoint.py``
+v2: validate-before-use, loud rejection): a receiver computes the CRC
+over the received payload and REJECTS the frame on mismatch — it never
+advances its expected sequence number past bytes it could not verify.
+A torn frame (socket closed mid-frame) surfaces as
+:class:`TruncatedFrame` and ends the connection; the acked-sequence
+resume makes the tear harmless.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+MAGIC = 0x4749  # "GI"
+_HEADER = struct.Struct(">HBBQII")
+HEADER_BYTES = _HEADER.size
+
+# Frame types.
+HELLO = 1    # client -> server: open/resume a stream
+WELCOME = 2  # server -> client: carries the server's next expected seq
+DATA = 3     # client -> server: one compressed chunk payload
+ACK = 4      # server -> client: every seq < value is durably folded
+REJECT = 5   # server -> client: frame refused; value = expected seq
+PAUSE = 6    # server -> client: backpressure — stop sending
+RESUME = 7   # server -> client: backpressure released
+BYE = 8      # either side: orderly close
+
+FRAME_TYPES = (HELLO, WELCOME, DATA, ACK, REJECT, PAUSE, RESUME, BYE)
+
+# Bound on a single payload (64 MiB): a length prefix beyond it is
+# treated as a corrupt header, not an allocation request.
+MAX_PAYLOAD = 64 << 20
+
+
+class FrameError(ValueError):
+    """The frame failed validation (bad magic/type/length/CRC)."""
+
+
+class CrcMismatch(FrameError):
+    """Payload bytes do not match the header CRC — corrupt in flight."""
+
+
+class TruncatedFrame(FrameError):
+    """The stream ended mid-frame (torn write / dropped connection)."""
+
+
+def pack_frame(ftype: int, seq: int, payload: bytes = b"") -> bytes:
+    """Serialize one frame; CRC computed over the payload bytes."""
+    if ftype not in FRAME_TYPES:
+        raise FrameError(f"unknown frame type {ftype}")
+    if len(payload) > MAX_PAYLOAD:
+        raise FrameError(
+            f"payload of {len(payload)} bytes exceeds MAX_PAYLOAD "
+            f"({MAX_PAYLOAD})"
+        )
+    return _HEADER.pack(
+        MAGIC, ftype, 0, seq, len(payload), zlib.crc32(payload)
+    ) + payload
+
+
+def unpack_header(buf: bytes) -> tuple[int, int, int, int]:
+    """Parse a header; returns (type, seq, length, crc)."""
+    if len(buf) < HEADER_BYTES:
+        raise TruncatedFrame(
+            f"{len(buf)} header bytes of {HEADER_BYTES}"
+        )
+    magic, ftype, _flags, seq, length, crc = _HEADER.unpack(
+        buf[:HEADER_BYTES]
+    )
+    if magic != MAGIC:
+        raise FrameError(f"bad magic {magic:#06x}")
+    if ftype not in FRAME_TYPES:
+        raise FrameError(f"unknown frame type {ftype}")
+    if length > MAX_PAYLOAD:
+        raise FrameError(
+            f"declared payload length {length} exceeds MAX_PAYLOAD"
+        )
+    return ftype, seq, length, crc
+
+
+def read_frame(recv) -> tuple[int, int, bytes]:
+    """Read one frame off ``recv(n) -> bytes`` (a socket-recv-like
+    callable). Returns ``(type, seq, payload)``; the payload CRC is
+    verified here — :class:`CrcMismatch` on corruption,
+    :class:`TruncatedFrame` on a stream that ends mid-frame, and a
+    clean EOF (zero bytes at a frame boundary) returns ``(BYE, 0,
+    b"")``.
+    """
+    ftype, seq, payload, ok = read_frame_checked(recv)
+    if not ok:
+        raise CrcMismatch(
+            f"frame seq={seq}: payload CRC mismatch — corrupt in flight"
+        )
+    return ftype, seq, payload
+
+
+def read_frame_checked(recv) -> tuple[int, int, bytes, bool]:
+    """Like :func:`read_frame` but reports a CRC mismatch as ``ok =
+    False`` instead of raising — the receiver then still KNOWS the
+    frame's claimed seq (the bytes were consumed off the stream either
+    way) and can send a targeted REJECT so the sender retransmits.
+    Truncation and malformed headers still raise: past those the
+    stream has no trustworthy frame boundary left."""
+    head = _read_exact(recv, HEADER_BYTES, allow_eof=True)
+    if head is None:
+        return BYE, 0, b"", True
+    ftype, seq, length, crc = unpack_header(head)
+    payload = b""
+    if length:
+        payload = _read_exact(recv, length, allow_eof=False)
+    return ftype, seq, payload, zlib.crc32(payload) == crc
+
+
+def _read_exact(recv, n: int, allow_eof: bool):
+    parts = []
+    got = 0
+    while got < n:
+        b = recv(n - got)
+        if not b:
+            if allow_eof and got == 0:
+                return None
+            raise TruncatedFrame(f"stream ended after {got} of {n} bytes")
+        parts.append(b)
+        got += len(b)
+    return b"".join(parts)
+
+
+# --------------------------------------------------------------------- #
+# payload codec: dict[str, np.ndarray] <-> bytes
+
+_PAYLOAD_HEAD = struct.Struct(">H")
+_ARR_HEAD = struct.Struct(">B")
+
+
+def pack_payload(payload: dict) -> bytes:
+    """Serialize a dict of numpy arrays (sorted key order, so equal
+    dicts produce identical bytes and hence identical CRCs)."""
+    out = [_PAYLOAD_HEAD.pack(len(payload))]
+    for key in sorted(payload):
+        arr = np.ascontiguousarray(payload[key])
+        kb = key.encode()
+        dt = arr.dtype.str.encode()  # e.g. b"<i4" — endianness explicit
+        out.append(_ARR_HEAD.pack(len(kb)))
+        out.append(kb)
+        out.append(_ARR_HEAD.pack(len(dt)))
+        out.append(dt)
+        out.append(_ARR_HEAD.pack(arr.ndim))
+        out.append(struct.pack(f">{arr.ndim}Q", *arr.shape))
+        out.append(struct.pack(">Q", arr.nbytes))
+        out.append(arr.tobytes())
+    return b"".join(out)
+
+
+def unpack_payload(buf: bytes) -> dict:
+    """Inverse of :func:`pack_payload`; :class:`FrameError` on any
+    structural inconsistency (the CRC already vouched for the bytes —
+    this guards against a malformed SENDER, not corruption)."""
+    view = memoryview(buf)
+    pos = 0
+
+    def take(n):
+        nonlocal pos
+        if pos + n > len(view):
+            raise FrameError("payload body shorter than its structure")
+        out = view[pos:pos + n]
+        pos += n
+        return out
+
+    (count,) = _PAYLOAD_HEAD.unpack(take(_PAYLOAD_HEAD.size))
+    out: dict = {}
+    for _ in range(count):
+        (klen,) = _ARR_HEAD.unpack(take(1))
+        key = bytes(take(klen)).decode()
+        (dlen,) = _ARR_HEAD.unpack(take(1))
+        dtype = np.dtype(bytes(take(dlen)).decode())
+        (ndim,) = _ARR_HEAD.unpack(take(1))
+        shape = struct.unpack(f">{ndim}Q", take(8 * ndim))
+        (nbytes,) = struct.unpack(">Q", take(8))
+        want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if nbytes != want:
+            raise FrameError(
+                f"array {key!r}: {nbytes} bytes declared but shape "
+                f"{shape} x {dtype} needs {want}"
+            )
+        out[key] = np.frombuffer(take(nbytes), dtype=dtype).reshape(shape)
+    if pos != len(view):
+        raise FrameError(
+            f"{len(view) - pos} trailing bytes after the last array"
+        )
+    return out

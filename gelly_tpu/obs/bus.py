@@ -26,6 +26,21 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``coordination.leader_elected``       observed leadership changes
 ``coordination.rejoins``              restart-time re-joins
 ``coordination.degradations``         degraded-capacity takeovers
+``ingest.frames_received``            wire frames decoded by the server
+``ingest.frames_rejected``            CRC-mismatch / gap / malformed
+``ingest.frames_truncated``           torn frames (conn died mid-frame)
+``ingest.frames_duplicate``           reconnect replays dropped+re-acked
+``ingest.chunks_enqueued``            payloads staged for the consumer
+``ingest.bytes_received``             cumulative wire bytes in
+``ingest.acks_sent``                  durability acks pushed to clients
+``ingest.backpressure_engaged``       PAUSE engagements (event)
+``ingest.staged_depth``               server staging queue depth (gauge)
+``ingest.paused``                     1 while PAUSEd (gauge)
+``ingest.frames_sent``                client DATA frames transmitted
+``ingest.frames_resent``              client retransmits after rewind
+``ingest.pauses_received``            PAUSE frames seen by the client
+``ingest.rejects_received``           REJECT frames seen by the client
+``ingest.reshards``                   routing-table re-shard events
 ``engine.units_folded``               pipeline units retired by a fold
 ``engine.chunks_folded``              chunks inside those units
 ``engine.edges_folded``               valid edges (tracer-enabled runs)

@@ -28,7 +28,7 @@ class _Error:
 
 
 def prefetch(it: Iterable[T], depth: int = 2,
-             gauge=None) -> Iterator[T]:
+             gauge=None, name: str = "gelly-prefetch") -> Iterator[T]:
     """Iterate ``it`` on a background thread, ``depth`` items ahead.
 
     Cancellation-safe: abandoning the returned generator (break /
@@ -39,6 +39,10 @@ def prefetch(it: Iterable[T], depth: int = 2,
     each successful enqueue — the observability hook the pipelined
     executor wires to an ``obs`` bus gauge so span traces can record
     queue-depth-at-enqueue. None (the default) costs nothing.
+
+    ``name`` names the worker thread — span traces use thread names as
+    per-lane track ids, so the sharded source readers pass
+    ``gelly-reader_<s>`` to get one Perfetto track per reader lane.
     """
     if depth <= 0:
         yield from it
@@ -82,8 +86,7 @@ def prefetch(it: Iterable[T], depth: int = 2,
                     if cancel.is_set():
                         break
 
-    t = threading.Thread(target=worker, daemon=True,
-                         name="gelly-prefetch")
+    t = threading.Thread(target=worker, daemon=True, name=name)
     t.start()
     try:
         while True:

@@ -1,0 +1,112 @@
+"""Subprocess body for the SIGKILL'd-ingest-server recovery test
+(test_ingest_protocol.py) — the ``_crash_child.py`` harness pattern
+applied to the wire.
+
+Runs an :class:`~gelly_tpu.ingest.server.IngestServer` with
+``auto_ack=False`` feeding a checkpointed numpy CC fold: a frame is
+ACKed only after a checkpoint covering its position is durably written,
+so a SIGKILL at ANY point can never double-fold an acked chunk — the
+restarted incarnation resumes the sequence at its newest valid
+checkpoint and the client resends exactly the unacked suffix. The fold
+state carries chunk/edge counters (union is idempotent, counters are
+not), so the parent's exactly-once assertion is sharp.
+
+argv: <ckpt_dir> <port_file> <out_npz> <total_chunks> [chunk_sleep_s]
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_V = 256
+CKPT_EVERY = 4
+
+
+def init_state() -> dict:
+    return {
+        "parent": np.arange(N_V, dtype=np.int32),
+        "chunks": np.zeros((), dtype=np.int64),
+        "edges": np.zeros((), dtype=np.int64),
+    }
+
+
+def _find(parent: np.ndarray, v: int) -> int:
+    while parent[v] != v:
+        parent[v] = parent[parent[v]]
+        v = parent[v]
+    return int(v)
+
+
+def fold(state: dict, payload: dict) -> dict:
+    parent = state["parent"].copy()
+    src = np.asarray(payload["src"])
+    dst = np.asarray(payload["dst"])
+    for a, b in zip(src.tolist(), dst.tolist()):
+        ra, rb = _find(parent, a), _find(parent, b)
+        if ra != rb:
+            lo, hi = (ra, rb) if ra < rb else (rb, ra)
+            parent[hi] = lo
+    return {
+        "parent": parent,
+        "chunks": state["chunks"] + 1,
+        "edges": state["edges"] + np.int64(src.shape[0]),
+    }
+
+
+def labels(state: dict) -> np.ndarray:
+    parent = state["parent"].copy()
+    return np.asarray([_find(parent, v) for v in range(N_V)],
+                      dtype=np.int32)
+
+
+def main(argv):
+    ckpt_dir, port_file, out_path = argv[0], argv[1], argv[2]
+    total = int(argv[3])
+    sleep_s = float(argv[4]) if len(argv) > 4 else 0.0
+
+    from gelly_tpu.engine.checkpoint import save_checkpoint
+    from gelly_tpu.engine.resilience import CheckpointManager
+    from gelly_tpu.ingest import IngestServer
+
+    # Synchronous writes: the ack that follows a save must imply the
+    # bytes are durable BEFORE the client learns about it.
+    mgr = CheckpointManager(ckpt_dir, keep=3, async_write=False)
+    state = init_state()
+    pos = 0
+    found = mgr.load_latest(like=state)
+    if found is not None:
+        state, pos, _meta, _path = found
+
+    srv = IngestServer(auto_ack=False, resume_seq=pos,
+                       queue_depth=8).start()
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(srv.port))
+    os.replace(tmp, port_file)
+
+    try:
+        for seq, payload in srv.payloads():
+            if sleep_s:
+                time.sleep(sleep_s)
+            assert seq == pos, f"sequence skew: frame {seq} at position {pos}"
+            state = fold(state, payload)
+            pos = seq + 1
+            if pos % CKPT_EVERY == 0:
+                mgr.save(state, pos)
+                srv.ack(pos)  # durability first, ack second
+            if pos == total:
+                break
+        mgr.save(state, pos)
+        srv.ack(pos)
+    finally:
+        srv.stop()
+    save_checkpoint(out_path, state, position=pos)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,486 @@
+"""gelly_tpu.ingest wire protocol: framing, CRC, resume, backpressure.
+
+The edge cases the ISSUE names: a torn frame mid-write (connection dies
+inside a frame), a CRC-mismatched frame (rejected + counted, expected
+seq NOT advanced, client retransmits), a client reconnect resuming at
+the acked sequence number, gauge-driven backpressure bounding the
+staged depth at the high-water mark, and — slow-marked, in the CI
+ingest lane — a SIGKILL'd server restarting without double-folding
+acked chunks (the ``_crash_child.py`` harness pattern on the wire).
+"""
+
+import io
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from gelly_tpu.ingest import (
+    IngestClient,
+    IngestServer,
+    edge_payload,
+    pack_frame,
+    pack_payload,
+    read_frame,
+    unpack_payload,
+)
+from gelly_tpu.ingest import wire
+from gelly_tpu.obs import bus as obs_bus
+
+pytestmark = pytest.mark.ingest
+
+
+# --------------------------------------------------------------------- #
+# framing + payload codec
+
+
+def test_frame_roundtrip():
+    body = pack_payload({"v": np.arange(9, dtype=np.int32)})
+    buf = io.BytesIO(pack_frame(wire.DATA, 41, body))
+    ftype, seq, payload = read_frame(buf.read)
+    assert (ftype, seq) == (wire.DATA, 41)
+    np.testing.assert_array_equal(unpack_payload(payload)["v"],
+                                  np.arange(9))
+
+
+def test_payload_codec_roundtrip_and_determinism():
+    p = {
+        "v": np.arange(7, dtype=np.int32),
+        "r": np.array([[1, 2], [3, 4]], dtype="<i8"),
+        "w": np.array([0.5, 1.5], dtype="<f4"),
+    }
+    b1, b2 = pack_payload(p), pack_payload(dict(reversed(p.items())))
+    assert b1 == b2  # sorted key order -> identical bytes/CRC
+    out = unpack_payload(b1)
+    assert set(out) == set(p)
+    for k in p:
+        np.testing.assert_array_equal(out[k], p[k])
+
+
+def test_payload_codec_rejects_malformed():
+    good = pack_payload({"v": np.arange(4, dtype=np.int32)})
+    with pytest.raises(wire.FrameError):
+        unpack_payload(good[:-3])  # shorter than its structure
+    with pytest.raises(wire.FrameError):
+        unpack_payload(good + b"xx")  # trailing junk
+
+
+def test_header_validation():
+    with pytest.raises(wire.FrameError, match="magic"):
+        wire.unpack_header(b"XX" + b"\0" * (wire.HEADER_BYTES - 2))
+    bad_len = struct.pack(">HBBQII", wire.MAGIC, wire.DATA, 0, 0,
+                          wire.MAX_PAYLOAD + 1, 0)
+    with pytest.raises(wire.FrameError, match="MAX_PAYLOAD"):
+        wire.unpack_header(bad_len)
+    with pytest.raises(wire.FrameError, match="frame type"):
+        wire.unpack_header(struct.pack(">HBBQII", wire.MAGIC, 99, 0, 0,
+                                       0, 0))
+
+
+def test_crc_mismatch_detected():
+    body = pack_payload({"v": np.arange(4, dtype=np.int32)})
+    frame = bytearray(pack_frame(wire.DATA, 3, body))
+    frame[-1] ^= 0xFF  # flip one payload byte
+    with pytest.raises(wire.CrcMismatch):
+        read_frame(io.BytesIO(bytes(frame)).read)
+    ftype, seq, _payload, ok = wire.read_frame_checked(
+        io.BytesIO(bytes(frame)).read
+    )
+    assert (ftype, seq, ok) == (wire.DATA, 3, False)
+
+
+def test_truncated_frame_detected():
+    body = pack_payload({"v": np.arange(4, dtype=np.int32)})
+    frame = pack_frame(wire.DATA, 3, body)
+    with pytest.raises(wire.TruncatedFrame):
+        read_frame(io.BytesIO(frame[: len(frame) // 2]).read)
+    # Clean EOF at a frame boundary is BYE, not an error.
+    assert read_frame(io.BytesIO(b"").read)[0] == wire.BYE
+
+
+# --------------------------------------------------------------------- #
+# loopback server/client
+
+
+def _drain(server, out, stop_after=None, delay=0.0):
+    def run():
+        for seq, payload in server.payloads():
+            out.append((seq, payload))
+            if delay:
+                time.sleep(delay)
+            if stop_after is not None and len(out) >= stop_after:
+                return
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_loopback_stream_in_order_with_acks():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                for i in range(25):
+                    cli.send(edge_payload([i], [i + 1]))
+                cli.flush(timeout=10)
+                assert cli.acked == 25
+                assert cli.unacked_count == 0
+        t.join(timeout=5)
+        assert [s for s, _ in got] == list(range(25))
+        assert got[7][1]["src"].tolist() == [7]
+        snap = bus.snapshot()["counters"]
+        assert snap["ingest.chunks_enqueued"] == 25
+        assert snap["ingest.acks_sent"] >= 1
+
+
+def test_reconnect_resumes_at_acked_seq():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            for i in range(10):
+                cli.send(edge_payload([i], [i]))
+            cli.flush(timeout=10)
+            # Drop the connection without BYE; reconnect re-handshakes
+            # and the stream continues at the acked position.
+            cli._teardown_socket()
+            cli.reconnect()
+            for i in range(10, 15):
+                cli.send(edge_payload([i], [i]))
+            cli.flush(timeout=10)
+            cli.close()
+        t.join(timeout=5)
+        assert [s for s, _ in got] == list(range(15))
+        assert bus.snapshot()["counters"]["ingest.chunks_enqueued"] == 15
+
+
+def test_corrupt_frame_rejected_and_retransmitted():
+    """A CRC-mismatched DATA frame bumps ``ingest.frames_rejected``,
+    does NOT advance the expected seq, and the client's REJECT handler
+    retransmits — the stream completes exactly-once anyway."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            cli.send(edge_payload([0], [0]))
+            cli.flush(timeout=10)
+            # Inject a corrupt frame for seq 1 BEHIND the client's back
+            # (raw socket write with a flipped payload byte), then send
+            # the real seq 1 through the client: the corrupt copy is
+            # rejected, the real one lands.
+            body = pack_payload(edge_payload([1], [1]))
+            frame = bytearray(pack_frame(wire.DATA, 1, body))
+            frame[-1] ^= 0xFF
+            with cli._send_lock:
+                cli._sock.sendall(bytes(frame))
+            deadline = time.monotonic() + 5
+            while (bus.snapshot()["counters"].get(
+                    "ingest.frames_rejected", 0) < 1
+                    and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.next_seq == 1  # never advanced past bad bytes
+            cli.send(edge_payload([1], [1]))
+            cli.flush(timeout=10)
+            cli.close()
+        t.join(timeout=5)
+        snap = bus.snapshot()["counters"]
+        assert snap["ingest.frames_rejected"] >= 1
+        assert [s for s, _ in got] == [0, 1]
+        assert got[1][1]["src"].tolist() == [1]
+
+
+def test_torn_frame_mid_write_enqueues_nothing():
+    """A connection that dies mid-frame (header + partial payload)
+    must stage nothing, count ``ingest.frames_truncated``, and leave
+    the sequence intact for the next connection."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            body = pack_payload(edge_payload([5], [6]))
+            frame = pack_frame(wire.DATA, 0, body)
+            raw = socket.create_connection(("127.0.0.1", srv.port))
+            raw.sendall(frame[: len(frame) - 7])  # torn mid-payload
+            raw.close()
+            deadline = time.monotonic() + 5
+            while (bus.snapshot()["counters"].get(
+                    "ingest.frames_truncated", 0) < 1
+                    and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert bus.snapshot()["counters"]["ingest.frames_truncated"] == 1
+            assert srv.next_seq == 0
+            # The stream is still healthy: a proper client delivers.
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                cli.send(edge_payload([5], [6]))
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0]
+
+
+def test_duplicate_frames_dropped_and_reacked():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            cli.send(edge_payload([0], [0]))
+            cli.flush(timeout=10)
+            # Replay seq 0 raw (a reconnect race): dropped, re-acked.
+            body = pack_payload(edge_payload([0], [0]))
+            with cli._send_lock:
+                cli._sock.sendall(pack_frame(wire.DATA, 0, body))
+            cli.send(edge_payload([1], [1]))
+            cli.flush(timeout=10)
+            cli.close()
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1]
+        assert bus.snapshot()["counters"]["ingest.frames_duplicate"] == 1
+
+
+def test_backpressure_bounds_staged_depth_at_high_water():
+    """The acceptance-criteria contract: with high_water H and a slow
+    consumer, the ``ingest.staged_depth`` gauge never exceeds H, PAUSE
+    frames reach the client, and engagements are published."""
+    H = 3
+    with obs_bus.scope() as bus:
+        depths: list = []
+        with IngestServer(queue_depth=32, high_water=H, low_water=1,
+                          pause_poll_s=0.002) as srv:
+            orig_gauge = bus.gauge
+
+            def spy_gauge(name, value):
+                if name == "ingest.staged_depth":
+                    depths.append(value)
+                orig_gauge(name, value)
+
+            bus.gauge = spy_gauge
+            got: list = []
+            t = _drain(srv, got, delay=0.01)
+            with IngestClient("127.0.0.1", srv.port,
+                              send_pause_timeout=30) as cli:
+                for i in range(40):
+                    cli.send(edge_payload([i], [i]))
+                cli.flush(timeout=30)
+        t.join(timeout=10)
+        snap = bus.snapshot()["counters"]
+        assert len(got) == 40
+        assert snap["ingest.backpressure_engaged"] >= 1
+        assert snap["ingest.pauses_received"] >= 1
+        assert depths and max(depths) <= H
+        assert bus.snapshot()["gauges"]["ingest.paused"] == 0  # released
+
+
+def test_backpressure_is_gauge_driven():
+    """The server watches the ENGINE's ``pipeline.staged_depth`` gauge
+    too: a deep engine pipeline pauses wire admission even when the
+    server's own queue is empty."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=32, high_water=4, low_water=1,
+                          pause_poll_s=0.002) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            bus.gauge("pipeline.staged_depth", 10)  # engine side is deep
+            with IngestClient("127.0.0.1", srv.port,
+                              send_pause_timeout=30) as cli:
+                cli.send(edge_payload([0], [0]))
+                deadline = time.monotonic() + 5
+                while not cli.paused and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                assert cli.paused  # PAUSEd with an EMPTY server queue
+                assert bus.snapshot()["gauges"]["ingest.paused"] == 1
+                bus.gauge("pipeline.staged_depth", 0)  # engine drained
+                cli.send(edge_payload([1], [1]))
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1]
+
+
+def test_batched_acks_flush_on_idle_and_bye():
+    """ack_every > 1 must not strand the tail frames: the idle tick
+    (and BYE) flushes the batched-ack remainder, so a client flush()
+    after a non-multiple frame count completes instead of timing out."""
+    with obs_bus.scope():
+        with IngestServer(queue_depth=16, ack_every=3) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                for i in range(4):  # 4 % 3 != 0: one frame past the batch
+                    cli.send(edge_payload([i], [i]))
+                assert cli.flush(timeout=5) == 4
+        t.join(timeout=5)
+        assert [s for s, _ in got] == list(range(4))
+
+
+def test_payload_to_chunk_validates_vertex_capacity():
+    """File-ingest parity on the wire: an out-of-range id raises
+    loudly instead of truncating to int32 / vanishing in the fold."""
+    from gelly_tpu.ingest.server import payload_to_chunk
+
+    ok = payload_to_chunk(edge_payload([1, 2], [3, 4]), 8,
+                          vertex_capacity=8)
+    assert int(np.asarray(ok.valid).sum()) == 2
+    with pytest.raises(ValueError, match="out of range"):
+        payload_to_chunk(edge_payload([1, 70000], [2, 3]), 8,
+                         vertex_capacity=1 << 16)
+    with pytest.raises(ValueError, match="out of range"):
+        payload_to_chunk(edge_payload([-1], [2]), 8, vertex_capacity=8)
+    with pytest.raises(ValueError, match="chunk capacity"):
+        payload_to_chunk(edge_payload([0, 1, 2], [0, 1, 2]), 2)
+
+
+def test_ingest_fault_boundary_fires_on_send():
+    from gelly_tpu.engine import faults
+
+    with obs_bus.scope():
+        with IngestServer(queue_depth=4) as srv:
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                plan = faults.FaultPlan(
+                    [faults.Fault(boundary="ingest", at=0)]
+                )
+                with faults.install(plan):
+                    with pytest.raises(faults.FaultInjected):
+                        cli.send(edge_payload([0], [0]))
+                assert ("ingest", 0, "raise") in plan.fired
+
+
+# --------------------------------------------------------------------- #
+# SIGKILL'd server: no double-fold of acked chunks (slow; CI ingest lane)
+
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_ingest_crash_child.py")
+
+
+def _spawn_server_child(ckpt, port_file, out, total, sleep_s):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(ckpt), str(port_file), str(out),
+         str(total), str(sleep_s)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_port(port_file, proc, timeout=120):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"server child exited rc={proc.returncode} before "
+                "publishing its port"
+            )
+        if os.path.exists(port_file):
+            return int(open(port_file).read())
+        time.sleep(0.02)
+    raise AssertionError("server child never published its port")
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path):
+    import _ingest_crash_child as child_mod
+
+    rng = np.random.default_rng(23)
+    total = 64
+    payloads = [
+        edge_payload(rng.integers(0, child_mod.N_V, 32),
+                     rng.integers(0, child_mod.N_V, 32))
+        for _ in range(total)
+    ]
+    # Golden: the same fold, in-process, uninterrupted.
+    golden = child_mod.init_state()
+    for p in payloads:
+        golden = child_mod.fold(golden, p)
+
+    ckpt = tmp_path / "ckpt"
+    port_file = str(tmp_path / "port")
+    out = str(tmp_path / "final.npz")
+
+    p1 = _spawn_server_child(ckpt, port_file, out, total, 0.03)
+    port = _wait_port(port_file, p1)
+    cli = IngestClient("127.0.0.1", port, send_pause_timeout=60)
+    cli.connect()
+
+    sent = 0
+    send_died = threading.Event()
+
+    def sender():
+        nonlocal sent
+        from gelly_tpu.ingest.client import IngestError
+
+        while sent < total:
+            try:
+                cli.send(payloads[sent])
+                sent += 1
+            except IngestError:
+                # The failed send is already BUFFERED (resend-buffer
+                # contract): reconnect() will deliver it — count it.
+                sent += 1
+                send_died.set()
+                return
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+
+    # Kill once at least two durable checkpoints exist and acks flowed.
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if len(list(ckpt.glob("ckpt-*.npz"))) >= 2 and cli.acked >= 8:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("no checkpoints/acks before the deadline")
+    acked_before_kill = cli.acked
+    os.kill(p1.pid, signal.SIGKILL)
+    assert p1.wait(timeout=60) == -signal.SIGKILL
+    assert not os.path.exists(out)  # died mid-stream
+    t.join(timeout=60)
+
+    # Restart: the new incarnation resumes the SEQUENCE at its newest
+    # valid checkpoint; the client reconnects and resends exactly the
+    # unacked suffix.
+    os.unlink(port_file)
+    p2 = _spawn_server_child(ckpt, port_file, out, total, 0.0)
+    cli.port = _wait_port(port_file, p2)
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            cli.reconnect()
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+    assert cli.acked >= acked_before_kill  # acked work never rewinds
+
+    while sent < total:  # finish the stream
+        cli.send(payloads[sent])
+        sent += 1
+    cli.flush(timeout=120)
+    cli.close()
+    assert p2.wait(timeout=180) == 0
+
+    from gelly_tpu.engine.checkpoint import load_checkpoint
+
+    final, pos, _ = load_checkpoint(out, like=child_mod.init_state())
+    assert pos == total
+    # THE exactly-once assertion: counters (non-idempotent) exact.
+    assert int(final["chunks"]) == total
+    assert int(final["edges"]) == sum(
+        int(p["src"].shape[0]) for p in payloads
+    )
+    np.testing.assert_array_equal(child_mod.labels(final),
+                                  child_mod.labels(golden))
+    assert final["parent"].tobytes() == golden["parent"].tobytes()

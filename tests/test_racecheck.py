@@ -58,6 +58,13 @@ def test_repo_tip_discovers_the_real_thread_roots():
     names = {r.entry.name for r in c.roots}
     assert {"writer", "run", "_beat_loop", "worker", "submitter",
             "stage_unit"} <= names
+    # The ingest subsystem's threads (ISSUE 9): the server accept loop
+    # and per-connection handler, the client's rx loop, and the sharded
+    # source's per-shard reader bodies (both the Thread-target `reader`
+    # in stage_units and the scan `drain`) must all be discovered —
+    # the RC-clean gate over gelly_tpu/ is vacuous for them otherwise.
+    assert {"_accept_loop", "_conn_loop", "_reader_loop", "reader",
+            "drain"} <= names
     assert any(r.daemon for r in c.roots)
     # and the cross-class typed descent reached LeaseBoard through
     # Coordinator._beat_loop -> self.board.beat()
