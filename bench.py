@@ -2546,12 +2546,353 @@ def bench_ingest(args) -> dict:
     return out
 
 
+def _tenant_chunks(seed: int, n_edges: int, n_v: int, chunk: int) -> list:
+    """Identity-slot host chunks for one tenant stream (numpy fast path —
+    the python tuple ingest would dominate a 256-tenant build)."""
+    from gelly_tpu.core.chunk import make_chunk
+
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_v, n_edges).astype(np.int64)
+    dst = rng.integers(0, n_v, n_edges).astype(np.int64)
+    return [
+        make_chunk(src[i:i + chunk].astype(np.int32),
+                   dst[i:i + chunk].astype(np.int32),
+                   raw_src=src[i:i + chunk], raw_dst=dst[i:i + chunk],
+                   capacity=chunk, device=False)
+        for i in range(0, n_edges, chunk)
+    ]
+
+
+def bench_tenants(args) -> dict:
+    """The multi-tenant batched fold engine (ISSUE 10): aggregate
+    edges/sec for N ∈ {1, 8, 64, 256} tenants, batched (ONE vmapped
+    dispatch advances every tenant per scheduling round) vs the
+    sequential-loop baseline (each tenant its own single-stream
+    ``run_aggregation`` pass over the same plan).
+
+    The structural claim holds on any host and is recorded per point:
+    ``fold_dispatches_batched`` stays at chunks-per-tenant regardless
+    of N, while the sequential loop pays N × that. The SPEEDUP claim
+    (aggregate eps ≥ 3x at N=64) is an accelerator-host capture: a
+    1-core CPU stand-in executes the vmapped lanes serially, so the
+    dispatch amortization it proves structurally cannot show up as
+    eps (codec_workers_block precedent — self-describing
+    ``scaling_measurable``/``skipped_reason``).
+    """
+    import os
+
+    from gelly_tpu.engine.aggregation import (
+        available_cores,
+        run_aggregation,
+    )
+    from gelly_tpu.engine.tenants import MultiTenantEngine
+    from gelly_tpu.library.connected_components import cc_tenant_tier
+
+    n_v = 1 << 12
+    chunk = 1 << 10
+    edges_per_tenant = 1 << 13  # 8 chunks/tenant
+    merge_every = 2
+    agg, cap = cc_tenant_tier(n_v, chunk_capacity=chunk)
+    chunks_per_tenant = edges_per_tenant // chunk
+
+    from gelly_tpu import obs
+
+    rows = {}
+    trace_info = {}
+    for n_tenants in (1, 8, 64, 256):
+        streams = {
+            t: _tenant_chunks(1000 + t, edges_per_tenant, n_v, chunk)
+            for t in range(n_tenants)
+        }
+        # Batched: one engine, one tier, N lanes. The N=64 acceptance
+        # point runs under a tracer: the exported timeline IS the proof
+        # that one fold span per scheduling round advances all N lanes.
+        eng = MultiTenantEngine(merge_every=merge_every)
+        eng.add_tier("bench", agg, cap)
+        for t in range(n_tenants):
+            eng.admit(t, "bench", chunks=streams[t])
+        tracer = (obs.SpanTracer(heartbeat_every_s=None)
+                  if n_tenants == 64 else None)
+        t0 = time.perf_counter()
+        if tracer is not None:
+            with obs.install(tracer):
+                out = eng.drain()
+        else:
+            out = eng.drain()
+        batched_s = time.perf_counter() - t0
+        if tracer is not None:
+            folds = tracer.spans("fold")
+            tpath = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "trace_tenants_n64.json",
+            )
+            obs.write_chrome_trace(
+                tpath, tracer, extra={"workload": "tenants_n64"},
+            )
+            trace_info = {
+                "trace_file": os.path.basename(tpath),
+                "trace_fold_spans": len(folds),
+                "trace_lanes_per_fold": sorted(
+                    {s["args"]["lanes"] for s in folds}
+                ),
+                "trace_one_dispatch_per_window": bool(
+                    len(folds) == chunks_per_tenant
+                ),
+            }
+        total_edges = n_tenants * edges_per_tenant
+
+        # Sequential-loop baseline on the SAME plan: one
+        # run_aggregation pass per tenant (inline ingest — thread-pool
+        # setup per tiny stream would swamp the 1-core baseline).
+        t0 = time.perf_counter()
+        seq_last = None
+        for t in range(n_tenants):
+            seq_last = np.asarray(
+                run_aggregation(
+                    agg, streams[t], merge_every=merge_every,
+                    ingest_workers=0, prefetch_depth=0, h2d_depth=0,
+                ).result()
+            )
+        seq_s = time.perf_counter() - t0
+        # Parity spot check: the batched engine's last tenant vs its
+        # single-stream run (bit-identical labels — the tests assert
+        # the full matrix; the bench keeps the capture honest).
+        parity = bool(
+            seq_last.tobytes()
+            == np.asarray(out[n_tenants - 1]).tobytes()
+        )
+        rows[str(n_tenants)] = {
+            "tenants": n_tenants,
+            "eps_batched": round(total_edges / max(batched_s, 1e-9), 1),
+            "eps_sequential": round(total_edges / max(seq_s, 1e-9), 1),
+            "speedup": round(seq_s / max(batched_s, 1e-9), 2),
+            "fold_dispatches_batched": eng.stats["dispatches"],
+            "fold_dispatches_sequential": n_tenants * chunks_per_tenant,
+            "one_dispatch_per_round": bool(
+                eng.stats["dispatches"] == chunks_per_tenant
+            ),
+            "parity": parity,
+        }
+
+    cores = available_cores()
+    speedup64 = rows["64"]["speedup"]
+    out = {
+        "metric": "tenants_batched_fold",
+        "value": speedup64,
+        "unit": "x aggregate eps vs sequential loop at N=64",
+        "vertex_capacity": n_v,
+        "chunk": chunk,
+        "edges_per_tenant": edges_per_tenant,
+        "merge_every": merge_every,
+        "sweep": rows,
+        "dispatch_amortization_ok": all(
+            r["one_dispatch_per_round"] for r in rows.values()
+        ),
+        **trace_info,
+        "parity_ok": all(r["parity"] for r in rows.values()),
+        "available_cores": cores,
+        # The 3x-at-N=64 acceptance bar needs lanes that actually run
+        # in parallel (vector units across tenants on an accelerator);
+        # a 1-core CPU serializes them, so the eps claim is deferred to
+        # a TPU capture while the dispatch-count proof stands here.
+        "scaling_measurable": bool(cores >= 2 and speedup64 >= 1.0),
+    }
+    if not out["scaling_measurable"]:
+        out["skipped_reason"] = (
+            f"{cores}-core CPU stand-in: vmapped tenant lanes execute "
+            "serially, so aggregate eps cannot beat the sequential loop "
+            "here; the amortization is proven structurally instead — "
+            "fold_dispatches_batched == chunks_per_tenant "
+            f"({chunks_per_tenant}) at every N while the sequential "
+            "loop pays N x that (fold_dispatches_sequential)"
+        )
+    return out
+
+
+_DELTA_CROSSOVER_CHILD = r"""
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+from gelly_tpu.core.chunk import make_chunk
+from gelly_tpu.engine.aggregation import run_aggregation
+from gelly_tpu.library.connected_components import connected_components
+from gelly_tpu.obs import bus as obs_bus
+from gelly_tpu.parallel import mesh as mesh_lib
+
+S = 8
+m = mesh_lib.make_mesh(S)
+CAP = 1 << 14  # static chunk capacity; valid mask carries the row count
+WINDOWS = 4
+rng = np.random.default_rng(23)
+
+def stream_for(n_v, rows):
+    # Each window touches ~`rows` distinct vertices: a near-path over a
+    # rotating contiguous range (dirty rows scale with `rows`, not CAP).
+    chunks = []
+    for w in range(WINDOWS):
+        base = (w * rows * 2) % max(1, n_v - rows - 1)
+        a = base + rng.integers(0, rows, CAP).astype(np.int64)
+        b = np.minimum(a + 1, n_v - 1)
+        valid_n = min(rows, CAP)
+        src = np.zeros(CAP, np.int64); dst = np.zeros(CAP, np.int64)
+        src[:valid_n] = a[:valid_n]; dst[:valid_n] = b[:valid_n]
+        c = make_chunk(src.astype(np.int32), dst.astype(np.int32),
+                       raw_src=src, raw_dst=dst, capacity=CAP,
+                       device=False)
+        mask = np.zeros(CAP, bool); mask[:valid_n] = True
+        chunks.append(c._replace(valid=c.valid & mask))
+    return chunks
+
+# Two capacity classes: the small one is where the replicated merge is
+# cheap enough for the crossover to land INSIDE the densities a chunk
+# can generate; the large one documents the delta margin at serving
+# capacity (the r05 regime where replicated hit the 32.2s cliff).
+out = {}
+for n_v in (1 << 15, 1 << 18):
+    sweep = {}
+    for rows in (256, 1024, 4096, 8192, 16384):
+        row = {}
+        # ONE stream per (capacity, density) point: both modes fold the
+        # IDENTICAL chunks, so delta_s vs replicated_s differ only by
+        # the window-close path (the shared rng would otherwise hand
+        # each mode different edges — cross-stream noise in the very
+        # comparison the calibration derives from).
+        chunks = stream_for(n_v, rows)
+        for mode in ("delta", "replicated"):
+            agg = connected_components(
+                n_v, merge="gather", ingest_combine=False,
+                merge_mode=mode,
+            )
+            with obs_bus.scope() as bus:
+                res = run_aggregation(
+                    agg, chunks, mesh=m, merge_every=1,
+                    ingest_workers=0, prefetch_depth=0, h2d_depth=0,
+                )
+                # Warm compile on a separate pass, then time the drain.
+                for _ in res:
+                    pass
+                res = run_aggregation(
+                    agg, chunks, mesh=m, merge_every=1,
+                    ingest_workers=0, prefetch_depth=0, h2d_depth=0,
+                )
+                t0 = time.perf_counter()
+                for _ in res:
+                    pass
+                row[mode + "_s"] = round(time.perf_counter() - t0, 4)
+                if mode == "delta":
+                    row["measured_dirty_rows"] = int(
+                        bus.gauges.get("engine.window_dirty_rows", -1)
+                    )
+        sweep[str(rows)] = row
+    out[str(n_v)] = sweep
+print(json.dumps(out))
+"""
+
+
+def merge_delta_crossover_block() -> dict:
+    """The ``merge_delta_auto_rows`` crossover sweep (ISSUE 10
+    satellite): per-window dirty rows measured off the
+    ``engine.window_dirty_rows`` gauge PR 5 wired, against the wall of
+    merge_mode="delta" vs "replicated" on identical streams — so
+    ``merge_mode="auto"`` gets a MEASURED threshold instead of the
+    ``capacity/4`` structural guess (pass it back through
+    ``connected_components(delta_auto_rows=)``). Runs on the
+    8-virtual-device CPU mesh in a clean child (same harness as
+    ``sharded_state_cc``); the recommended value is chip-relative —
+    re-record on the serving hardware.
+    """
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    kept = " ".join(
+        t for t in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in t
+    )
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"{kept} --xla_force_host_platform_device_count=8".strip(),
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-I", "-c",
+             f"import sys; sys.path.insert(0, {here!r})\n"
+             + _DELTA_CROSSOVER_CHILD],
+            env=env, cwd=here, capture_output=True, text=True,
+            timeout=1800,
+        )
+        if proc.returncode != 0:
+            return {"metric": "merge_delta_crossover",
+                    "error": proc.stderr[-400:]}
+        rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — never kill the headline line
+        return {"metric": "merge_delta_crossover",
+                "error": f"{type(e).__name__}: {e}"[:400]}
+    S = 8
+    calibration = {}
+    headline = None
+    for n_v_str, sweep in rows.items():
+        n_v = int(n_v_str)
+        crossover = None
+        for r in sorted(sweep, key=int):
+            if sweep[r]["delta_s"] >= sweep[r]["replicated_s"]:
+                crossover = int(r)
+                break
+        if crossover is None:
+            # Delta won at EVERY density a chunk can generate at this
+            # capacity — including dirty ≈ capacity: the measured
+            # threshold sits at or above the densest point, so the
+            # cap/4 default is too CONSERVATIVE here (it hands dense
+            # windows to the replicated merge delta still beats).
+            # Record the densest measured win as a lower bound.
+            densest = max(sweep, key=int)
+            count = sweep[densest]["measured_dirty_rows"]
+            bound = "lower"
+        else:
+            count = sweep[str(crossover)]["measured_dirty_rows"]
+            bound = "measured"
+        bucket = max(256, 1 << max(0, count - 1).bit_length())
+        # The engine's auto rule compares S * bucket to the plan's
+        # merge_delta_auto_rows: the calibrated value is the gathered
+        # row count at the crossover density (or at the densest
+        # delta-won point when no crossover landed in the sweep).
+        recommended = S * bucket
+        if headline is None:
+            headline = recommended
+        calibration[n_v_str] = {
+            "crossover_rows": crossover,
+            "bound": bound,
+            "default_auto_rows": n_v // 4,
+            "recommended_delta_auto_rows": recommended,
+            "recommended_frac_of_capacity": round(recommended / n_v, 4),
+            "sweep": sweep,
+        }
+    return {
+        "metric": "merge_delta_crossover",
+        "value": headline,
+        "unit": "calibrated merge_delta_auto_rows (gathered rows) at "
+                "the smallest measured capacity (8-dev CPU mesh)",
+        "shards": S,
+        "calibration": calibration,
+        "calibration_note": (
+            "pass recommended_delta_auto_rows to "
+            "connected_components(delta_auto_rows=) on this chip; "
+            "bound='lower' means delta won at every measurable "
+            "density (crossover above the sweep — the cap/4 default "
+            "switches to replicated too early); CPU-mesh capture — "
+            "re-record on the serving hardware"
+        ),
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--workload", default="all",
                    choices=["all", "cc", "cc_large", "degrees", "triangles",
                             "bipartiteness", "matching", "spanner", "codec",
-                            "gather", "ingest"])
+                            "gather", "ingest", "tenants"])
     # K-points for the subprocess codec-scaling sweep (codec_workers_eps):
     # comma list; oversubscribed K on small hosts is fine (the points then
     # bound, rather than exhibit, scaling).
@@ -2602,6 +2943,11 @@ def main() -> int:
         return 0
     if args.workload == "ingest":
         emit(bench_ingest(args))
+        write_bench_artifact(args.workload)
+        return 0
+    if args.workload == "tenants":
+        emit(bench_tenants(args))
+        emit(merge_delta_crossover_block())
         write_bench_artifact(args.workload)
         return 0
     if args.workload == "spanner":
@@ -2663,6 +3009,8 @@ def main() -> int:
         for name, heavy in (
             ("spanner_device", lambda: bench_spanner(args)),
             ("ingest", lambda: bench_ingest(args)),
+            ("tenants_batched_fold", lambda: bench_tenants(args)),
+            ("merge_delta_crossover", merge_delta_crossover_block),
             ("streaming_cc_throughput", lambda: bench_cc(args)),
             ("sharded_state_cc", bench_sharded_state),
             ("streaming_cc_large", lambda: bench_cc_large(args)),

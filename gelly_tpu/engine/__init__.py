@@ -18,6 +18,10 @@ from .coordination import (
     ManifestCorruptError,
     MixedEpochError,
 )
+from .tenants import (
+    MultiTenantEngine,
+    TenantBatch,
+)
 from .resilience import (
     CheckpointManager,
     ResilienceConfig,

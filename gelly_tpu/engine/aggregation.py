@@ -36,7 +36,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 from functools import partial
-from typing import Any, Callable, Iterator
+from typing import Any, Callable, Iterator, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -633,6 +633,133 @@ def _compiled_plan(agg: SummaryAggregation, m):
     plan = (fold_step, merge_locals, merger_step, locals0_fn,
             transform_fn, fold_many, fold_codec, delta_count_fn,
             merge_delta_for, flatten_fn)
+    per_agg[key] = plan
+    return plan
+
+
+class TenantPlan(NamedTuple):
+    """Compiled vmapped physical plan for one tenant tier (see
+    ``engine/tenants.py``): every function operates on summaries STACKED
+    along a leading tenant axis of static width ``lanes``, so one donated
+    dispatch advances every lane of the tier."""
+
+    init: Callable[[], Summary]  # -> [lanes, ...]-stacked fresh summaries
+    fold: Callable[..., Summary]  # (stacked, stacked_chunk, active) -> stacked
+    merger: Callable[[Summary, Summary], Summary]  # vmapped combine
+    transform: Callable[[Summary], Any] | None  # vmapped transform
+    snapshot: Callable[[Summary], Any]  # query-safe copy (never aliases)
+    flatten: Callable[[Summary], Summary] | None  # vmapped path flatten
+    lanes: int
+
+
+def _compiled_tenant_plan(agg: SummaryAggregation, lanes: int,
+                          mesh=None) -> TenantPlan:
+    """Build (and memoize on the aggregation instance, like
+    :func:`_compiled_plan`) the vmapped tenant-tier plan.
+
+    The tenant axis replaces the shard axis as the data-parallel axis:
+    ``fold``/``combine``/``transform`` are ``jax.vmap``-ed over a leading
+    axis of ``lanes`` tenants, and the fold DONATES the stacked state —
+    one dispatch, zero steady-state allocation, N tenants advanced.
+    ``active`` masks no-op lanes (a tenant with no pending chunk keeps
+    its summary bit-unchanged via a per-lane select), so stragglers
+    never stall the batch. Tiers share one compiled program per
+    ``lanes`` width (widths grow by doubling, so a stream of admissions
+    compiles O(log N) programs, not O(N)).
+
+    With ``mesh`` spanning S > 1 devices and ``lanes % S == 0`` the
+    TENANT axis itself is sharded across the mesh — the lanes are
+    data-parallel with no cross-lane collectives, so XLA partitions the
+    vmapped program for free.
+
+    Plans that fold only through a stateful host codec
+    (``requires_codec`` / ``stack_ordered``) are refused loudly: their
+    id-assignment sessions are per-run host state the stacked batch
+    cannot share. Host-side transforms (``jit_transform=False``) are
+    refused too — queries read device snapshots.
+    """
+    if lanes < 1:
+        raise ValueError(f"lanes must be >= 1, got {lanes}")
+    if agg.requires_codec or agg.stack_ordered:
+        raise ValueError(
+            f"aggregation '{agg.name}' folds through a stateful host codec "
+            "(requires_codec/stack_ordered); the tenant batch folds raw "
+            "chunks — build the tier plan without the ordered codec (e.g. "
+            "connected_components(..., ingest_combine=False) or "
+            "codec='sparse')"
+        )
+    if agg.transform is not None and not agg.jit_transform:
+        raise ValueError(
+            f"aggregation '{agg.name}' uses a host-side transform "
+            "(jit_transform=False); tenant snapshots are device-resident "
+            "vmapped transforms"
+        )
+    mesh_key = None
+    sharding = None
+    if mesh is not None and mesh_lib.num_shards(mesh) > 1:
+        S = mesh_lib.num_shards(mesh)
+        if lanes % S:
+            raise ValueError(
+                f"tenant lanes {lanes} must be a multiple of the "
+                f"{S}-device mesh to shard the tenant axis"
+            )
+        mesh_key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+        sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    key = ("tenants", lanes, agg.fold_backend, agg.merge_mode, mesh_key)
+    per_agg = agg.__dict__.setdefault("_plan_cache", {})
+    if key in per_agg:
+        return per_agg[key]
+
+    jit_kw = {} if sharding is None else {"out_shardings": sharding}
+
+    @partial(jax.jit, **jit_kw)
+    def batch_init():
+        return jax.tree.map(
+            lambda l: jnp.broadcast_to(l[None], (lanes,) + l.shape),
+            agg.init(),
+        )
+
+    def _lane_fold(s, chunk, active):
+        # Masked no-op lane: the fold still runs (static shapes, one
+        # program) but an inactive lane's summary is selected back
+        # bit-unchanged — the fairness contract's "no-op masked lane".
+        s2 = agg.fold(s, chunk)
+        return jax.tree.map(
+            lambda new, old: jnp.where(active, new, old), s2, s
+        )
+
+    # The tenant-axis donation: steady-state tenant folds write the new
+    # stacked summary into the old one's buffers (same contract as the
+    # single-stream fold_step — the engine rebinds the state on every
+    # call and snapshots only through `snapshot`, which never aliases).
+    batch_fold = jax.jit(jax.vmap(_lane_fold), donate_argnums=0, **jit_kw)
+
+    batch_merger = jax.jit(jax.vmap(agg.combine), **jit_kw)
+
+    batch_transform = (
+        jax.jit(jax.vmap(agg.transform), **jit_kw)
+        if agg.transform is not None else None
+    )
+
+    if batch_transform is not None:
+        snapshot_fn = batch_transform
+    else:
+        # Query snapshots must never alias the live (donated-into-next-
+        # fold) state buffers: jnp.copy dispatched EAGERLY is a real
+        # device copy — a jitted identity could alias its input.
+        def snapshot_fn(s):
+            return jax.tree.map(jnp.copy, s)
+
+    batch_flatten = (
+        jax.jit(jax.vmap(agg.flatten), **jit_kw)
+        if agg.flatten is not None else None
+    )
+
+    plan = TenantPlan(
+        init=batch_init, fold=batch_fold, merger=batch_merger,
+        transform=batch_transform, snapshot=snapshot_fn,
+        flatten=batch_flatten, lanes=lanes,
+    )
     per_agg[key] = plan
     return plan
 

@@ -195,9 +195,27 @@ class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3,
                  retry: RetryPolicy | None = None,
                  async_write: bool = True, seed: int = 0,
-                 write_timeout: float | None = None):
+                 write_timeout: float | None = None,
+                 prefix: str = "ckpt"):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
+        # ``prefix`` namespaces a rotation inside a SHARED directory —
+        # the multi-tenant engine keeps one ``t<tenant>-<pos>.npz``
+        # rotation per tenant in one dir instead of thousands of
+        # directories. The trailing "-" separator keeps prefixes
+        # prefix-free ("t7-*" never matches t77's files) — which only
+        # holds if the prefix itself contains no "-": "t7-*" WOULD
+        # match a prefix "t7-0"'s files, letting one rotation prune
+        # and load another tenant's checkpoints. Callers with
+        # arbitrary ids must escape them (engine/tenants.py does).
+        if not prefix or "-" in prefix or any(
+            sep and sep in prefix for sep in (os.sep, os.altsep)
+        ):
+            raise ValueError(
+                f"prefix must be a non-empty file-name fragment "
+                f"without '-' (the rotation separator), got {prefix!r}"
+            )
+        self.prefix = prefix
         self.directory = directory
         self.keep = keep
         self.retry = retry or RetryPolicy()
@@ -234,11 +252,18 @@ class CheckpointManager:
         self._fail_lock = threading.Lock()
 
     def path_for(self, position: int) -> str:
-        return os.path.join(self.directory, f"ckpt-{position:012d}.npz")
+        return os.path.join(
+            self.directory, f"{self.prefix}-{position:012d}.npz"
+        )
 
     def list(self) -> list[str]:
-        """Checkpoint paths, oldest → newest (position-ordered)."""
-        return sorted(glob.glob(os.path.join(self.directory, "ckpt-*.npz")))
+        """This rotation's checkpoint paths, oldest → newest
+        (position-ordered; other prefixes sharing the directory are
+        invisible to it)."""
+        return sorted(glob.glob(os.path.join(
+            glob.escape(self.directory), glob.escape(self.prefix)
+            + "-*.npz"
+        )))
 
     def save(self, state, position: int, meta: dict | None = None) -> None:
         host = jax.device_get(state)

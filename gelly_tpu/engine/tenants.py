@@ -1,0 +1,789 @@
+"""Multi-tenant serving engine: one dispatch advances N streams.
+
+``run_aggregation`` dedicates the whole device to a single stream, yet
+the r05 capture shows the fold dispatch is effectively free (0.0009s
+against an 11.0s wall) — a service multiplexing thousands of
+independent graph streams (one per tenant/session) onto one chip must
+amortize dispatch, H2D and compile cost across tenants the way the
+reference lets Flink multiplex many jobs onto shared slots
+(PAPER.md §L1: ``GraphStream`` per job, slots shared by the cluster).
+
+The engine here owns that multiplexing natively:
+
+- **Tenant batching** (:class:`TenantBatch`): per-tenant summary states
+  are stacked along a leading tenant axis and the compiled plan's
+  fold/merge/transform are ``jax.vmap``-ed over it
+  (:func:`~gelly_tpu.engine.aggregation._compiled_tenant_plan`), so ONE
+  donated dispatch advances every lane of a tier. Lane widths grow by
+  doubling — a stream of admissions compiles O(log N) programs.
+- **Capacity tiers**: tenants are admitted into named tiers, each tier
+  one ``SummaryAggregation`` plan (its ``vertex_capacity`` is the
+  tier's capacity class) and one chunk capacity; all tenants of a tier
+  share one compiled program per lane width, keyed like
+  ``fold_backend``/``merge_mode`` in the engine's plan cache.
+- **Fair-share windowing** (:class:`MultiTenantEngine`): per-tenant
+  chunk queues; every scheduling round advances each backlogged tenant
+  by at most one chunk, and a tenant with nothing pending contributes
+  a no-op MASKED lane — stragglers never stall the batch, and every
+  backlogged tenant advances at the same chunk rate. Starvation is
+  observable: ``tenants.starved_windows`` counts live-tenant lanes
+  that went masked in a dispatch.
+- **Per-tenant exactly-once checkpoints**: each tenant's lane is
+  snapshotted through its own :class:`~gelly_tpu.engine.resilience.
+  CheckpointManager` rotation (distinct filename prefixes in one
+  shared directory), riding the existing position-header/CRC
+  checkpoint format unchanged. The recorded position is the tenant's
+  last DISPATCHED chunk at a window close — resume re-reads exactly
+  the un-folded suffix, bit-identical to an unkilled run
+  (``tests/_tenants_crash_child.py`` proves it under SIGKILL).
+- **Live queries** (:meth:`MultiTenantEngine.query` /
+  :meth:`~MultiTenantEngine.labels`): reads are answered from the last
+  merge-window snapshot (the vmapped ``transform`` output, or a real
+  device copy for transform-less plans), swapped in under a lock that
+  is held only for the reference swap — a query never blocks a window
+  close and a window close never blocks a query; staleness is bounded
+  by ONE merge window.
+
+The fold loop runs inline (:meth:`~MultiTenantEngine.drain`, finite
+workloads) or on a scheduler thread (:meth:`~MultiTenantEngine.start`,
+serving mode) — queries and submits are safe from any thread in both
+modes. In serving mode an idle scheduler flushes partial windows
+(emit-what-you-have), so slow tenants still see fresh snapshots.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.chunk import EdgeChunk
+from ..obs import bus as obs_bus
+from ..obs import tracing as obs_tracing
+from .aggregation import SummaryAggregation, _compiled_tenant_plan
+
+logger = logging.getLogger("gelly_tpu.tenants")
+
+
+def tenant_prefix(tenant_id) -> str:
+    """Injective, filesystem-safe checkpoint prefix for a tenant id.
+
+    Every character outside ``[A-Za-z0-9_]`` percent-escapes (``%`` is
+    itself escaped, so the map is injective), which keeps the prefix
+    free of ``-`` — the rotation separator ``CheckpointManager`` splits
+    file names on. Without this, ids "7" and "7-0" would glob into
+    each other's rotations (one tenant pruning/loading another's
+    checkpoints)."""
+    s = str(tenant_id)
+    return "t" + "".join(
+        c if (c.isascii() and (c.isalnum() or c == "_"))
+        else "%" + "".join(f"{b:02x}" for b in c.encode("utf-8"))
+        for c in s
+    )
+
+
+def _normalize_chunk(chunk: EdgeChunk, capacity: int) -> EdgeChunk:
+    """Host-normalize a tenant chunk for cross-tenant stacking: fixed
+    dtypes for the id columns (folds read the dense ``src``/``dst``
+    slots; ``raw_*`` widths vary by source and are widened to i64 so
+    every tenant's chunks stack into one [N, C] batch)."""
+    h = chunk if chunk.is_host() else chunk.to_numpy()
+    if h.capacity != capacity:
+        raise ValueError(
+            f"tenant chunk capacity {h.capacity} != tier chunk capacity "
+            f"{capacity} — all tenants of a tier share one static shape"
+        )
+    return h._replace(
+        src=np.asarray(h.src, np.int32),
+        dst=np.asarray(h.dst, np.int32),
+        raw_src=np.asarray(h.raw_src, np.int64),
+        raw_dst=np.asarray(h.raw_dst, np.int64),
+        ts=np.asarray(h.ts, np.int64),
+        event=np.asarray(h.event, np.int8),
+        valid=np.asarray(h.valid, bool),
+        val=np.asarray(h.val),
+    )
+
+
+class TenantBatch:
+    """Stacked per-tenant summary state for one capacity tier.
+
+    Owns the [lanes, ...]-stacked pytrees (window locals and, for
+    non-accumulate plans, the carried global stack), the compiled
+    :class:`~gelly_tpu.engine.aggregation.TenantPlan` for the current
+    lane width, and the width-doubling growth path: widening
+    re-initializes a wider stack and copies the existing lanes in, so
+    admitted tenants keep their state across recompiles.
+    """
+
+    def __init__(self, agg: SummaryAggregation, chunk_capacity: int,
+                 mesh=None, min_lanes: int = 1):
+        self.agg = agg
+        self.chunk_capacity = int(chunk_capacity)
+        self.mesh = mesh
+        self.min_lanes = max(1, int(min_lanes))
+        self.lanes = 0
+        self.plan = None
+        # The accumulate plan (SummaryAggregation.fold_accumulates): one
+        # running stacked summary, no per-window merger — the same
+        # physical-plan specialization the single-stream engine applies
+        # at S == 1.
+        self.accum = agg.fold_accumulates and not agg.transient
+        self.state = None  # accum: the running stack; else: window locals
+        self.global_ = None  # non-accum only: the carried global stack
+        self.sharding = None  # tenant-axis NamedSharding on an S>1 mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel import mesh as mesh_lib
+            from ..parallel.mesh import SHARD_AXIS
+
+            if mesh_lib.num_shards(mesh) > 1:
+                self.sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self._zero_chunk: EdgeChunk | None = None
+        self._template: EdgeChunk | None = None
+
+    def _width_for(self, n: int) -> int:
+        want = max(self.min_lanes, n, 1)
+        w = 1 << max(0, want - 1).bit_length()
+        if self.sharding is not None:
+            from ..parallel import mesh as mesh_lib
+
+            S = mesh_lib.num_shards(self.mesh)
+            w = -(-max(w, S) // S) * S
+        return w
+
+    def ensure_lanes(self, n: int) -> None:
+        """Grow the stack to hold ``n`` lanes (pow-2 widths; existing
+        lanes copied into the widened stack)."""
+        if self.plan is not None and n <= self.lanes:
+            return
+        width = self._width_for(n)
+        plan = _compiled_tenant_plan(self.agg, width, mesh=self.mesh)
+        old_lanes = self.lanes
+
+        def widen(old):
+            fresh = plan.init()
+            if old is None or old_lanes == 0:
+                return fresh
+            # Eager per-widening copy (O(log N) times per run): the old
+            # lanes land in the low rows of the fresh stack.
+            return jax.tree.map(
+                lambda f, o: f.at[:old_lanes].set(o), fresh, old
+            )
+
+        self.state = widen(self.state)
+        if not self.accum:
+            self.global_ = widen(self.global_)
+        self.plan = plan
+        self.lanes = width
+
+    def set_lane(self, lane: int, host_state) -> None:
+        """Overwrite one lane's RUNNING summary from a host pytree
+        (checkpoint resume). For non-accumulate plans the restored
+        summary is the tenant's carried global; its window locals stay
+        fresh (new lanes initialize fresh in :meth:`ensure_lanes`)."""
+        target = "state" if self.accum else "global_"
+        cur = getattr(self, target)
+        setattr(self, target, jax.tree.map(
+            lambda l, h: l.at[lane].set(jnp.asarray(h)), cur, host_state,
+        ))
+
+    def slice_lane(self, lane: int):
+        """Device slice of one tenant's RUNNING summary (accum: the live
+        stack; non-accum: the carried global — call at a window close,
+        when locals are freshly merged)."""
+        src = self.state if self.accum else self.global_
+        return jax.tree.map(lambda l: l[lane], src)
+
+    def stack_chunks(self, per_lane: list) -> tuple:
+        """Host-stack one chunk (or a masked zero chunk) per lane into
+        the [lanes, C] batch + the bool[lanes] active mask."""
+        first = next((c for c in per_lane if c is not None), None)
+        if first is None:
+            raise ValueError("stack_chunks needs at least one live lane")
+        if self._template is None:
+            self._template = first
+            self._zero_chunk = EdgeChunk(
+                *(np.zeros_like(f) for f in first)
+            )
+        tmpl = self._template
+        for c in per_lane:
+            if c is None:
+                continue
+            for name, f, tf in zip(EdgeChunk._fields, c, tmpl):
+                if f.dtype != tf.dtype or f.shape != tf.shape:
+                    raise ValueError(
+                        f"tenant chunk field {name!r} ({f.dtype}{f.shape})"
+                        f" differs from the tier template "
+                        f"({tf.dtype}{tf.shape}) — tenants of a tier must"
+                        " ship identically-shaped chunks"
+                    )
+        rows = [c if c is not None else self._zero_chunk for c in per_lane]
+        rows += [self._zero_chunk] * (self.lanes - len(per_lane))
+        stacked = EdgeChunk(*(np.stack(fs) for fs in zip(*rows)))
+        active = np.zeros((self.lanes,), bool)
+        active[: len(per_lane)] = [c is not None for c in per_lane]
+        return stacked, active
+
+
+class _Tenant:
+    """Per-tenant bookkeeping. Fields shared between the scheduler
+    thread and submitters/queriers are guarded by the engine lock."""
+
+    __slots__ = ("tid", "tier", "lane", "queue", "source", "consumed",
+                 "finished", "done", "starved_windows", "manager",
+                 "pending_state", "ready")
+
+    def __init__(self, tid, tier: str, lane: int):
+        self.tid = tid
+        self.tier = tier
+        self.lane = lane
+        self.queue: deque = deque()
+        self.source: Iterator | None = None
+        self.consumed = 0  # chunks whose fold was dispatched
+        self.finished = False  # no more input will arrive
+        self.done = False  # finished AND queue drained
+        self.starved_windows = 0
+        self.manager = None
+        self.pending_state = None  # host pytree awaiting lane write
+        # False until admit() has installed the lane state and resume
+        # position: a running scheduler must neither pull nor dispatch
+        # a half-admitted tenant (it would fold into a fresh lane the
+        # pending resume state then clobbers, and admit's final
+        # ``consumed = position`` write would erase its increments).
+        self.ready = False
+
+
+class _Tier:
+    __slots__ = ("name", "batch", "chunks_in_window", "snapshot",
+                 "snapshot_window", "windows_closed", "last_ckpt_window")
+
+    def __init__(self, name: str, batch: TenantBatch):
+        self.name = name
+        self.batch = batch
+        self.chunks_in_window = 0
+        self.snapshot = None  # last closed window's stacked emission
+        self.snapshot_window = 0
+        self.windows_closed = 0
+        self.last_ckpt_window = 0
+
+
+class MultiTenantEngine:
+    """Admission + fair-share scheduling over tenant-batched folds.
+
+    ``merge_every`` — dispatch rounds per merge window (each round
+    advances every backlogged tenant by one chunk). ``checkpoint_dir``
+    + ``checkpoint_every`` (windows) enable per-tenant exactly-once
+    checkpoints; ``resume=True`` reloads each tenant's newest valid
+    checkpoint at admission and skips its already-folded prefix.
+    ``mesh`` (optional, S > 1 devices) shards the TENANT axis across
+    the mesh — lanes are data-parallel, so the vmapped program
+    partitions with no cross-lane collectives.
+
+    Locking: ``_lock`` guards the tenant/tier tables, queues and
+    snapshot references (held only for dict/deque/reference work —
+    never across a dispatch, transfer or file write, so queries and
+    submits stay wait-free against device work); ``_dispatch_lock``
+    serializes batch-state mutation between the scheduler thread and
+    admissions (lane widening must not interleave with a fold).
+    """
+
+    def __init__(self, *, merge_every: int = 1,
+                 checkpoint_dir: str | None = None,
+                 checkpoint_every: int = 1, resume: bool = False,
+                 mesh=None, poll_s: float = 0.005):
+        if merge_every < 1:
+            raise ValueError(f"merge_every must be >= 1, got {merge_every}")
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
+        self.merge_every = merge_every
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.resume = resume
+        self.mesh = mesh
+        self.poll_s = poll_s
+        self._lock = threading.Lock()
+        self._dispatch_lock = threading.Lock()
+        self._tiers: dict[str, _Tier] = {}
+        self._tenants: dict[Any, _Tenant] = {}
+        self._stop = threading.Event()
+        self._work = threading.Event()
+        self._thread: threading.Thread | None = None
+        # Set by ingest.TenantRouter: the engine then re-publishes the
+        # shared ``pipeline.staged_depth`` gauge every scheduler loop —
+        # the router alone publishes only on submit, so a paused client
+        # (no submits) would leave the gauge stuck above low_water and
+        # the server's RESUME poll spinning forever.
+        self.publish_staged_gauge = False
+        self.stats = {"dispatches": 0, "chunks": 0, "windows_closed": 0,
+                      "starved_lanes": 0}
+
+    # ------------------------------------------------------------ control
+
+    def add_tier(self, name: str, agg: SummaryAggregation,
+                 chunk_capacity: int, min_lanes: int = 1) -> None:
+        """Register a capacity tier: one plan + one chunk shape, shared
+        by every tenant admitted into it. Plan constraints are
+        validated at first lane build (see ``_compiled_tenant_plan``)."""
+        with self._lock:
+            if name in self._tiers:
+                raise ValueError(f"tier {name!r} already registered")
+            self._tiers[name] = _Tier(
+                name,
+                TenantBatch(agg, chunk_capacity, mesh=self.mesh,
+                            min_lanes=min_lanes),
+            )
+
+    def admit(self, tenant_id, tier: str, chunks=None) -> int:
+        """Admit a tenant into ``tier``; returns its lane index.
+
+        ``chunks`` — optional chunk source (an iterable/iterator, an
+        ``EdgeStream``, or anything ``engine/resilience`` can make
+        seekable); the scheduler pulls from it lazily, one chunk per
+        scheduling round. Without one, feed the tenant with
+        :meth:`submit` + :meth:`finish`. With ``resume=True`` the
+        tenant's newest valid checkpoint is loaded and a seekable
+        source is fast-forwarded past the recorded position (push-mode
+        callers must replay from :meth:`position` themselves — the
+        ingest router's ``resume_seq`` contract).
+        """
+        with self._lock:
+            if tenant_id in self._tenants:
+                raise ValueError(f"tenant {tenant_id!r} already admitted")
+            tr = self._tiers.get(tier)
+            if tr is None:
+                raise ValueError(
+                    f"unknown tier {tier!r} (registered: "
+                    f"{sorted(self._tiers)})"
+                )
+            lane = sum(
+                1 for t in self._tenants.values() if t.tier == tier
+            )
+            t = _Tenant(tenant_id, tier, lane)
+            self._tenants[tenant_id] = t
+        # Heavy work (checkpoint load, plan compile, lane widening)
+        # OUTSIDE the table lock — queries and submits stay responsive
+        # during admission; the dispatch lock keeps the widening from
+        # interleaving with an in-flight fold.
+        position = 0
+        if self.checkpoint_dir is not None:
+            from .resilience import CheckpointManager
+
+            # Under the dispatch lock: manager construction reaps stale
+            # ``*.npz.tmp`` files in the SHARED directory, which must
+            # not interleave with another tenant's in-flight checkpoint
+            # write (_checkpoint_tier holds the same lock).
+            with self._dispatch_lock:
+                t.manager = CheckpointManager(
+                    self.checkpoint_dir, prefix=tenant_prefix(tenant_id),
+                    async_write=False,
+                )
+                if self.resume:
+                    found = t.manager.load_latest(
+                        like=tr.batch.agg.init()
+                    )
+                    if found is not None:
+                        state, position, _meta, path = found
+                        t.pending_state = jax.tree.map(np.asarray, state)
+                        logger.info(
+                            "tenant %r resuming from %s at chunk %d",
+                            tenant_id, path, position,
+                        )
+        source = None
+        if chunks is not None:
+            from .resilience import _make_seekable
+
+            source = iter(_make_seekable(chunks)(position))
+        elif position:
+            logger.info(
+                "tenant %r resumed at chunk %d in push mode — the "
+                "submitter must replay from that position", tenant_id,
+                position,
+            )
+        with self._dispatch_lock:
+            tr.batch.ensure_lanes(lane + 1)
+            if t.pending_state is not None:
+                tr.batch.set_lane(lane, t.pending_state)
+                t.pending_state = None
+        # Publish atomically: position, source and readiness land in one
+        # locked write — the scheduler never sees a dispatchable tenant
+        # whose resume position could still be overwritten.
+        with self._lock:
+            t.consumed = position
+            t.source = source
+            t.ready = True
+        self._work.set()
+        return lane
+
+    def submit(self, tenant_id, chunk: EdgeChunk) -> None:
+        """Push one chunk onto a tenant's queue (any thread)."""
+        with self._lock:
+            t = self._tenants[tenant_id]
+            if t.finished:
+                raise ValueError(
+                    f"tenant {tenant_id!r} is finished; no more chunks"
+                )
+            cap = self._tiers[t.tier].batch.chunk_capacity
+        h = _normalize_chunk(chunk, cap)
+        with self._lock:
+            t.queue.append(h)
+        self._work.set()
+
+    def finish(self, tenant_id) -> None:
+        """Mark a push-mode tenant's stream complete: once its queue
+        drains, the tenant is done."""
+        with self._lock:
+            self._tenants[tenant_id].finished = True
+        self._work.set()
+
+    def position(self, tenant_id) -> int:
+        """Chunks folded for this tenant (the exactly-once resume point
+        is the newest checkpoint at or below this)."""
+        with self._lock:
+            return self._tenants[tenant_id].consumed
+
+    def chunk_capacity(self, tier: str) -> int:
+        """The tier's static chunk capacity (wire routers size their
+        payload→chunk padding from it)."""
+        with self._lock:
+            return self._tiers[tier].batch.chunk_capacity
+
+    def queue_depth(self, tenant_id=None) -> int:
+        with self._lock:
+            if tenant_id is not None:
+                return len(self._tenants[tenant_id].queue)
+            return sum(len(t.queue) for t in self._tenants.values())
+
+    def starved_windows(self, tenant_id) -> int:
+        with self._lock:
+            return self._tenants[tenant_id].starved_windows
+
+    # ------------------------------------------------------------ queries
+
+    def query(self, tenant_id, v: int | None = None):
+        """Read a tenant's last merge-window snapshot (staleness bound:
+        one merge window). ``v`` indexes array snapshots (labels /
+        degrees); ``None`` returns the whole row. Returns ``None``
+        before the first window close. Never blocks a window close —
+        the lock is held only to read the snapshot reference."""
+        with self._lock:
+            t = self._tenants[tenant_id]
+            tier = self._tiers[t.tier]
+            snap = tier.snapshot
+            lane = t.lane
+        if snap is None:
+            return None
+        # D2H outside the lock: a slow transfer must not serialize the
+        # scheduler's snapshot swap (or other queries).
+        if v is None:
+            return jax.tree.map(lambda l: np.asarray(l[lane]), snap)
+        return jax.tree.map(lambda l: np.asarray(l[lane, v]), snap)
+
+    # Canonical reads: labels(tenant, v) for CC tiers, degree(tenant, v)
+    # for degree tiers — both the same snapshot indexing.
+    labels = query
+    degree = query
+
+    def snapshot_window(self, tenant_id) -> int:
+        """Window number the tenant's snapshot was taken at (0 = none
+        yet) — the query-staleness handle."""
+        with self._lock:
+            tier = self._tiers[self._tenants[tenant_id].tier]
+            return tier.snapshot_window
+
+    # ------------------------------------------------------------ driving
+
+    def start(self) -> "MultiTenantEngine":
+        """Run the scheduler on a background thread (serving mode)."""
+        with self._lock:
+            if self._thread is not None:
+                raise RuntimeError("engine already started")
+            self._stop.clear()
+            th = threading.Thread(
+                target=self._drive_loop, daemon=True,
+                name="gelly-tenants",
+            )
+            self._thread = th
+        th.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._work.set()
+        with self._lock:
+            th, self._thread = self._thread, None
+        if th is not None:
+            th.join(timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def drain(self) -> dict:
+        """Run the scheduler INLINE until every admitted tenant is done
+        (finite workloads / tests); returns ``{tenant_id: final
+        snapshot row}`` from the last closed window."""
+        self._run(until_idle=True)
+        with self._lock:
+            tids = list(self._tenants)
+        return {tid: self.query(tid) for tid in tids}
+
+    def _drive_loop(self) -> None:
+        try:
+            self._run(until_idle=False)
+        except BaseException:
+            logger.exception("tenant scheduler died")
+            raise
+
+    # ---------------------------------------------------------- internals
+
+    def _pull_sources(self) -> None:
+        # Refill empty queues from pull-mode sources (scheduler thread
+        # only — sources are single-consumer; queue appends race only
+        # with submit(), which locks).
+        with self._lock:
+            pulls = [
+                t for t in self._tenants.values()
+                if t.ready and t.source is not None and not t.finished
+                and not t.queue
+            ]
+        for t in pulls:
+            chunk = next(t.source, None)
+            if chunk is None:
+                with self._lock:
+                    t.finished = True
+                continue
+            cap = self._tiers[t.tier].batch.chunk_capacity
+            h = _normalize_chunk(chunk, cap)
+            with self._lock:
+                t.queue.append(h)
+
+    def _run(self, until_idle: bool) -> None:
+        bus = obs_bus.get_bus()
+        tracer = obs_tracing.active_tracer()
+        hb = None
+        if tracer is not None and tracer.heartbeat_every_s is not None:
+            from ..obs.heartbeat import Heartbeat
+
+            hb = Heartbeat(tracer.heartbeat_every_s)
+        while not self._stop.is_set():
+            self._pull_sources()
+            advanced = self._round(bus, tracer)
+            with self._lock:
+                for t in self._tenants.values():
+                    if t.finished and not t.queue and not t.done:
+                        t.done = True
+                live = [t for t in self._tenants.values() if not t.done]
+                queued = sum(len(t.queue) for t in live)
+            bus.gauge("tenants.active", len(live))
+            bus.gauge("tenants.queue_depth", queued)
+            if self.publish_staged_gauge:
+                bus.gauge("pipeline.staged_depth", queued)
+            if hb is not None and hb.due():
+                hb.tick(
+                    tenants_active=len(live),
+                    tenants_queue_depth=queued,
+                    windows=self.stats["windows_closed"],
+                    chunks=self.stats["chunks"],
+                    starved=self.stats["starved_lanes"],
+                )
+            if advanced:
+                continue
+            # Nothing dispatched this round: flush partial windows so
+            # finished tenants' tails (and idle serving snapshots) emit.
+            self._flush_partial(bus, tracer)
+            if until_idle:
+                if not live:
+                    self._ensure_snapshots()
+                    self._final_checkpoints()
+                    return
+                if not queued:
+                    # Remaining live tenants are unfinished push-mode
+                    # feeds (exhausted pull sources flip `finished` in
+                    # _pull_sources): drain() would spin forever.
+                    raise RuntimeError(
+                        "drain() would wait forever: push-mode tenants "
+                        f"({[t.tid for t in live]}) have no pending "
+                        "chunks and were never finish()ed — call "
+                        "finish(tenant) or use start() for serving mode"
+                    )
+                continue
+            self._work.clear()
+            self._work.wait(self.poll_s)
+
+    def _round(self, bus, tracer) -> bool:
+        """One scheduling round: every tier with pending work gets ONE
+        vmapped dispatch advancing each backlogged tenant by one chunk.
+        Returns True when any tier dispatched."""
+        any_dispatch = False
+        with self._lock:
+            tiers = list(self._tiers.values())
+        for tier in tiers:
+            with self._lock:
+                members = [
+                    t for t in self._tenants.values()
+                    if t.tier == tier.name and t.ready
+                ]
+                # Index by LANE, not member order: a half-admitted
+                # neighbor (ready=False) must leave its lane masked,
+                # never shift another tenant's chunk into it.
+                width = 1 + max((t.lane for t in members), default=-1)
+                per_lane: list = [None] * width
+                took: list = []
+                starved = 0
+                for t in members:
+                    if t.queue:
+                        per_lane[t.lane] = t.queue.popleft()
+                        took.append(t)
+                    elif not t.finished and not t.done:
+                        starved += 1
+                        t.starved_windows += 1
+            if not took:
+                continue
+            batch = tier.batch
+            t0 = tracer.now() if tracer is not None else 0.0
+            with self._dispatch_lock:
+                batch.ensure_lanes(len(per_lane))
+                stacked, active = batch.stack_chunks(per_lane)
+                dev = jax.device_put(stacked, batch.sharding)
+                act = jax.device_put(active, batch.sharding)
+                # ONE donated dispatch advances every lane of the tier.
+                batch.state = batch.plan.fold(batch.state, dev, act)
+            with self._lock:
+                for t in took:
+                    t.consumed += 1
+                self.stats["dispatches"] += 1
+                self.stats["chunks"] += len(took)
+                if starved:
+                    self.stats["starved_lanes"] += starved
+            if starved:
+                bus.inc("tenants.starved_windows", starved)
+            bus.inc("tenants.dispatches")
+            bus.inc("tenants.chunks_folded", len(took))
+            if tracer is not None:
+                tracer.span(
+                    "fold", f"tenants/{tier.name}", t0,
+                    tier=tier.name, lanes=batch.lanes,
+                    advanced=len(took), starved=starved,
+                )
+            tier.chunks_in_window += 1
+            any_dispatch = True
+            if tier.chunks_in_window >= self.merge_every:
+                self._close_window(tier, bus, tracer)
+        return any_dispatch
+
+    def _close_window(self, tier: _Tier, bus, tracer) -> None:
+        batch = tier.batch
+        plan = batch.plan
+        t0 = tracer.now() if tracer is not None else 0.0
+        with self._dispatch_lock:
+            if batch.accum:
+                snap = plan.snapshot(batch.state)
+            else:
+                merged = plan.merger(batch.state, batch.global_)
+                if batch.agg.transient:
+                    # Reference Merger transientState semantics: emit
+                    # combine(window, global) then reset the global to
+                    # the combine identity (init).
+                    out = merged
+                    batch.global_ = plan.init()
+                else:
+                    batch.global_ = merged
+                    out = merged
+                batch.state = plan.init()
+                snap = plan.snapshot(out)
+            # The window's one completion barrier (merge_emit analog):
+            # the snapshot — and with it every fold of the window — is
+            # ready before queries can observe the new window number.
+            jax.block_until_ready(snap)
+        tier.chunks_in_window = 0
+        tier.windows_closed += 1
+        bus.inc("tenants.windows_closed")
+        with self._lock:
+            self.stats["windows_closed"] += 1
+            tier.snapshot = snap
+            tier.snapshot_window = tier.windows_closed
+        if tracer is not None:
+            tracer.span("merge_emit", f"tenants/{tier.name}", t0,
+                        tier=tier.name, window=tier.windows_closed)
+        if (self.checkpoint_dir is not None
+                and tier.windows_closed - tier.last_ckpt_window
+                >= self.checkpoint_every):
+            self._checkpoint_tier(tier)
+
+    def _checkpoint_tier(self, tier: _Tier) -> None:
+        batch = tier.batch
+        with self._dispatch_lock:
+            if batch.plan is not None and batch.plan.flatten is not None:
+                # Cadenced path flatten at checkpoint cadence (the
+                # engine contract: bounded transform chase depth on
+                # long streams; labels identical). The flattened stack
+                # REPLACES the live state and is what the per-lane
+                # snapshots slice.
+                if batch.accum:
+                    batch.state = batch.plan.flatten(batch.state)
+                else:
+                    batch.global_ = batch.plan.flatten(batch.global_)
+            with self._lock:
+                members = [
+                    (t, t.consumed) for t in self._tenants.values()
+                    if t.tier == tier.name and t.manager is not None
+                ]
+            for t, position in members:
+                t.manager.save(
+                    batch.slice_lane(t.lane), position,
+                    meta={"tenant": str(t.tid), "tier": tier.name,
+                          "window": tier.windows_closed},
+                )
+                obs_bus.publish_checkpoint(
+                    obs_bus.get_bus(), "tenants",
+                    t.manager.path_for(position),
+                )
+        tier.last_ckpt_window = tier.windows_closed
+
+    def _flush_partial(self, bus, tracer) -> None:
+        with self._lock:
+            tiers = list(self._tiers.values())
+        for tier in tiers:
+            if tier.chunks_in_window:
+                self._close_window(tier, bus, tracer)
+
+    def _ensure_snapshots(self) -> None:
+        # A tenant that resumed at end-of-stream folds zero new chunks
+        # and closes no window; its restored summary must still be
+        # queryable after drain() — snapshot the running state without
+        # counting a window.
+        with self._lock:
+            tiers = [
+                tier for tier in self._tiers.values()
+                if tier.snapshot is None and tier.batch.plan is not None
+                and any(t.tier == tier.name
+                        for t in self._tenants.values())
+            ]
+        for tier in tiers:
+            batch = tier.batch
+            with self._dispatch_lock:
+                src = batch.state if batch.accum else batch.global_
+                snap = batch.plan.snapshot(src)
+                jax.block_until_ready(snap)
+            with self._lock:
+                tier.snapshot = snap
+
+    def _final_checkpoints(self) -> None:
+        if self.checkpoint_dir is None:
+            return
+        with self._lock:
+            tiers = list(self._tiers.values())
+        for tier in tiers:
+            if tier.last_ckpt_window < tier.windows_closed:
+                self._checkpoint_tier(tier)

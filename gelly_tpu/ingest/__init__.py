@@ -39,7 +39,7 @@ from .readers import (
     edge_stream_from_sharded_file,
     write_binary_edges,
 )
-from .server import IngestServer
+from .server import IngestServer, TenantRouter
 from .wire import (
     FrameError,
     pack_frame,
@@ -53,6 +53,7 @@ __all__ = [
     "IngestServer",
     "ShardRoutingTable",
     "ShardedEdgeSource",
+    "TenantRouter",
     "FrameError",
     "byte_ranges",
     "edge_payload",

@@ -423,6 +423,129 @@ class IngestServer:
             self._send(sock, wire.pack_frame(wire.RESUME, 0))
 
 
+class TenantRouter:
+    """Route N client ingest streams into a multi-tenant engine's
+    per-tenant queues — under the ONE ``pipeline.staged_depth`` gauge.
+
+    Each attached :class:`IngestServer` (one port = one client stream)
+    gets a drain thread converting its payloads to chunks and
+    submitting them to the :class:`~gelly_tpu.engine.tenants.
+    MultiTenantEngine`; a payload's ``"tenant"`` entry (any 1-element
+    integer array the client adds next to ``src``/``dst``) selects the
+    tenant, falling back to the server's ``default_tenant``. Unknown
+    tenants are auto-admitted into ``tier`` (set ``auto_admit=False``
+    to reject them instead — counted as ``ingest.chunks_unroutable``).
+
+    Backpressure composes unchanged: after every submit the router
+    publishes the engine's TOTAL queued depth as the
+    ``pipeline.staged_depth`` gauge — the same gauge the single-stream
+    engine exposes — so every attached server's PAUSE/RESUME admission
+    check (``max`` of its own queue and the gauge) tracks the whole
+    engine backlog, not just its own socket buffer.
+
+    Delivery semantics are the attached servers' ``auto_ack`` contract
+    (the router acks nothing itself); per-tenant wire sequence spaces
+    remain the caller's to resume (``MultiTenantEngine.position`` is
+    the per-tenant replay point).
+    """
+
+    def __init__(self, engine, tier: str, *,
+                 vertex_capacity: int | None = None,
+                 tenant_of=None, auto_admit: bool = True):
+        self.engine = engine
+        self.tier = tier
+        self.vertex_capacity = vertex_capacity
+        self._tenant_of = tenant_of or (
+            lambda t: int(np.asarray(t).reshape(-1)[0])
+        )
+        self.auto_admit = auto_admit
+        # The engine re-publishes the shared gauge as its queues drain:
+        # the router alone publishes only on submit, which starves the
+        # servers' RESUME poll once a PAUSEd client stops sending.
+        engine.publish_staged_gauge = True
+        self._stop = threading.Event()
+        self._admit_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+
+    def attach(self, server: IngestServer,
+               default_tenant=None) -> threading.Thread:
+        """Start draining ``server`` (already started) into the engine."""
+        t = threading.Thread(
+            target=self._drain_loop, args=(server, default_tenant),
+            daemon=True, name="gelly-tenant-router",
+        )
+        self._threads.append(t)
+        t.start()
+        return t
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop routing (does NOT stop the attached servers — stopping
+        a server ends its drain thread via the payloads iterator)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _ensure_admitted(self, tid) -> bool:
+        with self._admit_lock:
+            try:
+                self.engine.position(tid)
+                return True  # already admitted
+            except KeyError:
+                pass
+            if not self.auto_admit:
+                return False
+            self.engine.admit(tid, self.tier)
+            return True
+
+    def _drain_loop(self, server: IngestServer, default_tenant) -> None:
+        bus = obs_bus.get_bus()
+        chunk_capacity = self.engine.chunk_capacity(self.tier)
+        for seq, payload in server.payloads():
+            if self._stop.is_set():
+                break
+            # Per-payload containment: a malformed payload (out-of-range
+            # ids, wrong shapes, a finished tenant) must drop THAT chunk
+            # — observably — not kill the drain thread while the server
+            # keeps staging and (auto_ack) ACK-ing frames nobody folds.
+            try:
+                wire_tenant = payload.pop("tenant", None)
+                tid = (
+                    default_tenant if wire_tenant is None
+                    else self._tenant_of(wire_tenant)
+                )
+                if tid is None or not self._ensure_admitted(tid):
+                    bus.inc("ingest.chunks_unroutable")
+                    logger.warning(
+                        "unroutable ingest payload (tenant=%r, no "
+                        "default); dropped", wire_tenant,
+                    )
+                    continue
+                chunk = payload_to_chunk(
+                    payload, chunk_capacity, self.vertex_capacity
+                )
+                self.engine.submit(tid, chunk)
+            except Exception as e:  # noqa: BLE001
+                bus.inc("ingest.chunks_invalid")
+                logger.warning(
+                    "invalid ingest payload seq=%d dropped (%s: %s)",
+                    seq, type(e).__name__, e,
+                )
+                continue
+            # The one shared gauge: every attached server's admission
+            # check reads it, so wire backpressure tracks the WHOLE
+            # engine backlog across all N client streams. (The engine's
+            # scheduler loop re-publishes it as queues DRAIN —
+            # publish_staged_gauge below — so a paused client can't
+            # strand the gauge above low_water.)
+            bus.gauge("pipeline.staged_depth", self.engine.queue_depth())
+
+
 class _ConnClosed(Exception):
     """Internal: the socket closed / the server is stopping."""
 

@@ -150,6 +150,7 @@ def connected_components_compact(
     vertex_capacity: int, merge: str = "gather",
     compact_capacity: int | None = None, wire: str = "auto",
     unit_block: int = 1 << 18, merge_mode: str = "auto",
+    delta_auto_rows: int | None = None,
 ) -> SummaryAggregation:
     """CC over a **persistent compact root space** — the large-N fast path
     (``codec="compact"``).
@@ -499,7 +500,9 @@ def connected_components_compact(
         merge_mode=resolve_merge_mode(merge_mode),
         merge_delta=merge_delta,
         merge_dirty_count=merge_dirty_count,
-        merge_delta_auto_rows=m // 4,
+        merge_delta_auto_rows=(
+            m // 4 if delta_auto_rows is None else int(delta_auto_rows)
+        ),
         name="connected-components-compact",
     )
     agg.session = session
@@ -600,10 +603,37 @@ def resolve_fold_backend(fold_backend: str, vertex_capacity: int) -> str:
     return "xla"
 
 
+def cc_tenant_tier(
+    vertex_capacity: int, chunk_capacity: int = 1 << 10,
+    fold_backend: str = "auto", delta_auto_rows: int | None = None,
+) -> tuple[SummaryAggregation, int]:
+    """Build a CC plan suitable for one multi-tenant capacity tier
+    (``engine/tenants.py``) — returns ``(agg, chunk_capacity)`` for
+    ``MultiTenantEngine.add_tier``.
+
+    Tenant batching vmaps the RAW fold over the tenant axis, so the
+    tier plan must fold raw chunks: the stateful compact-id codec
+    (``codec="compact"``) is per-run host state a stacked batch cannot
+    share, and the host-compress codecs never engage (the tenant
+    engine has no per-tenant compress stage — per-tenant chunks are
+    small, which is exactly why batching, not codec compression, is
+    the scarce-resource lever there). ``vertex_capacity`` is the
+    tier's capacity class: all tenants of the tier share one compiled
+    program per lane width, so admit tenants into the smallest tier
+    whose capacity covers them.
+    """
+    agg = connected_components(
+        vertex_capacity, merge="gather", ingest_combine=False,
+        fold_backend=fold_backend, delta_auto_rows=delta_auto_rows,
+    )
+    return agg, int(chunk_capacity)
+
+
 def connected_components(
     vertex_capacity: int, merge: str = "tree", ingest_combine: bool = True,
     codec: str = "auto", compact_capacity: int | None = None,
     fold_backend: str = "auto", merge_mode: str = "auto",
+    delta_auto_rows: int | None = None,
 ) -> SummaryAggregation:
     """Build the CC aggregation over a slot space of ``vertex_capacity``.
 
@@ -644,6 +674,14 @@ def connected_components(
     measures the dirty count each window close and picks per window.
     Like ``fold_backend``, the engine's compiled-plan cache keys on it.
 
+    ``delta_auto_rows`` overrides the ``"auto"`` crossover bound (max
+    gathered delta rows before the replicated merge wins). Default is
+    the ``capacity / 4`` structural guess; the bench's
+    ``merge_delta_crossover`` block measures the real crossover per
+    chip against the ``engine.window_dirty_rows`` gauge — pass the
+    calibrated value here (``BENCH_tenants_r01.json`` records one for
+    the CPU mesh).
+
     ``fold_backend`` picks the RAW device fold's kernel backend
     (:func:`resolve_fold_backend`): ``"pallas"`` routes the large-chunk
     sort-dedup fold's sorted chases through the VMEM-blocked gather
@@ -662,7 +700,7 @@ def connected_components(
             raise ValueError("codec='compact' requires ingest_combine=True")
         return connected_components_compact(
             vertex_capacity, merge=merge, compact_capacity=compact_capacity,
-            merge_mode=merge_mode,
+            merge_mode=merge_mode, delta_auto_rows=delta_auto_rows,
         )
     n = vertex_capacity
     sparse = resolve_sparse_codec(codec, n)
@@ -840,8 +878,12 @@ def connected_components(
         merge_dirty_count=_mk_count,
         # Auto threshold: delta rows cost ~8 bytes each on the wire +
         # pair-rate union work; past capacity/4 gathered rows the full
-        # replicated merge's sequential-scan unions win.
-        merge_delta_auto_rows=n // 4,
+        # replicated merge's sequential-scan unions win. The bench's
+        # merge_delta_crossover block measures the real bound per chip;
+        # delta_auto_rows carries the calibrated value in.
+        merge_delta_auto_rows=(
+            n // 4 if delta_auto_rows is None else int(delta_auto_rows)
+        ),
         name=f"connected-components-{merge}",
     )
 

@@ -41,6 +41,10 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``ingest.pauses_received``            PAUSE frames seen by the client
 ``ingest.rejects_received``           REJECT frames seen by the client
 ``ingest.reshards``                   routing-table re-shard events
+``ingest.chunks_unroutable``          tenant-router payloads dropped
+                                      (unknown tenant, no default)
+``ingest.chunks_invalid``             tenant-router payloads dropped
+                                      (bad ids/shapes/finished tenant)
 ``engine.units_folded``               pipeline units retired by a fold
 ``engine.chunks_folded``              chunks inside those units
 ``engine.edges_folded``               valid edges (tracer-enabled runs)
@@ -49,6 +53,16 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``engine.checkpoint_bytes``           aggregate-path checkpoint bytes
 ``pipeline.staged_depth``             compress→H2D queue depth (gauge)
 ``pipeline.h2d_depth``                H2D→fold queue depth (gauge)
+``tenants.active``                    live (not-done) tenants (gauge)
+``tenants.queue_depth``               total queued tenant chunks (gauge)
+``tenants.starved_windows``           live-tenant lanes dispatched as
+                                      masked no-ops (tenant had no
+                                      pending chunk at batch build)
+``tenants.dispatches``                vmapped tenant-batch dispatches
+``tenants.chunks_folded``             tenant chunks those advanced
+``tenants.windows_closed``            tenant merge windows closed
+``tenants.checkpoints``               per-tenant checkpoint writes
+``tenants.checkpoint_bytes``          cumulative tenant ckpt bytes
 ``sharded_cc.window_dirty_rows``      dirty entries at last emission
 ``sharded_cc.dirty_rows_gathered``    dirty rows pulled D2H, cumulative
 ====================================  =================================

@@ -1,0 +1,76 @@
+"""Subprocess body for the multi-tenant kill -9 crash test
+(test_tenants.py).
+
+Runs a MultiTenantEngine over T deterministic tenant streams with
+per-tenant checkpoints, throttled (sleep per source chunk) so the
+parent's SIGKILL lands mid-window with tenants at different positions.
+The second incarnation resumes every tenant from its own newest valid
+``t<tid>-<pos>.npz`` rotation and must produce final labels
+bit-identical to an unkilled run — proving the per-tenant
+last-dispatched-chunk position rule.
+
+argv: <checkpoint_dir> <out_npz> [chunk_sleep_seconds]
+Env: GELLY_TEN_TENANTS / _EDGES / _NV / _CHUNK override the shape.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gelly_tpu import edge_stream_from_edges  # noqa: E402
+from gelly_tpu.engine.checkpoint import save_checkpoint  # noqa: E402
+from gelly_tpu.engine.tenants import MultiTenantEngine  # noqa: E402
+from gelly_tpu.library.connected_components import (  # noqa: E402
+    cc_tenant_tier,
+)
+
+TENANTS = int(os.environ.get("GELLY_TEN_TENANTS", "3"))
+N_EDGES = int(os.environ.get("GELLY_TEN_EDGES", "768"))
+N_V = int(os.environ.get("GELLY_TEN_NV", "96"))
+CHUNK = int(os.environ.get("GELLY_TEN_CHUNK", "16"))
+
+
+def build_stream(tid: int):
+    rng = np.random.default_rng(100 + tid)
+    pairs = rng.integers(0, N_V, (N_EDGES, 2))
+    return edge_stream_from_edges(
+        [(int(a), int(b)) for a, b in pairs],
+        vertex_capacity=N_V, chunk_size=CHUNK,
+    )
+
+
+def throttled(stream, sleep_s: float):
+    def gen(position: int):
+        for c in stream.chunks_from(position):
+            if sleep_s:
+                time.sleep(sleep_s)
+            yield c
+
+    return gen  # a callable position -> iterator (seekable)
+
+
+def main(argv):
+    ckpt_dir, out_path = argv[0], argv[1]
+    sleep_s = float(argv[2]) if len(argv) > 2 else 0.0
+    agg, cap = cc_tenant_tier(N_V, chunk_capacity=CHUNK)
+    eng = MultiTenantEngine(
+        merge_every=2, checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        resume=True,
+    )
+    eng.add_tier("cc", agg, cap)
+    for tid in range(TENANTS):
+        eng.admit(tid, "cc", chunks=throttled(build_stream(tid), sleep_s))
+    out = eng.drain()
+    save_checkpoint(
+        out_path, [np.asarray(out[tid]) for tid in range(TENANTS)],
+        position=sum(eng.position(t) for t in range(TENANTS)),
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

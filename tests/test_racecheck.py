@@ -65,6 +65,11 @@ def test_repo_tip_discovers_the_real_thread_roots():
     # the RC-clean gate over gelly_tpu/ is vacuous for them otherwise.
     assert {"_accept_loop", "_conn_loop", "_reader_loop", "reader",
             "drain"} <= names
+    # The multi-tenant engine's scheduler thread and the ingest tenant
+    # router's per-server drain thread (ISSUE 10): both mutate shared
+    # tenant tables/queues/snapshots, so the RC-clean gate must be
+    # looking at them.
+    assert {"_drive_loop", "_drain_loop"} <= names
     assert any(r.daemon for r in c.roots)
     # and the cross-class typed descent reached LeaseBoard through
     # Coordinator._beat_loop -> self.board.beat()
