@@ -91,7 +91,14 @@ def save_checkpoint(path: str, summary, position: int = 0,
     }
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    # The tmp name carries the target basename so a crashed writer's
+    # leftover is attributable: CheckpointManager reaps stale tmps by
+    # ROTATION PREFIX at takeover, and an anonymous mkstemp name would
+    # make one rotation's cleanup delete another's in-flight write in
+    # a shared directory.
+    base = os.path.basename(path)
+    stem = base[: -len(".npz")] if base.endswith(".npz") else base
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=stem + "-", suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, __header__=np.frombuffer(

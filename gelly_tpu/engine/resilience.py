@@ -227,8 +227,15 @@ class CheckpointManager:
         os.makedirs(directory, exist_ok=True)
         # A SIGKILL mid-write leaves save_checkpoint's atomic-rename temp
         # behind; it can never be the newest valid checkpoint (the rename
-        # never happened), so reap it at takeover (single-writer dir).
-        for stale in glob.glob(os.path.join(directory, "*.npz.tmp")):
+        # never happened), so reap it at takeover. Scoped to THIS
+        # rotation's prefix: other rotations sharing the directory (the
+        # multi-tenant engine keeps one per tenant, and admissions run
+        # concurrently with checkpointing) may have writes in flight —
+        # a directory-wide reap would delete their tmp mid-write.
+        for stale in glob.glob(os.path.join(
+            glob.escape(directory), glob.escape(self.prefix)
+            + "-*.npz.tmp"
+        )):
             try:
                 os.unlink(stale)
             except OSError:

@@ -201,29 +201,38 @@ class TenantBatch:
         src = self.state if self.accum else self.global_
         return jax.tree.map(lambda l: l[lane], src)
 
+    def check_template(self, chunk: EdgeChunk) -> None:
+        """Validate a normalized chunk against the tier template (the
+        first chunk seen sets it). The engine calls this BEFORE a chunk
+        is queued, so a mismatch (e.g. a divergent ``val`` dtype
+        ``_normalize_chunk`` leaves alone) raises to the SUBMITTER —
+        were it first detected at stack time, the error would kill the
+        scheduler thread for every tenant, after the round had already
+        popped other tenants' chunks."""
+        if self._template is None:
+            self._template = chunk
+            self._zero_chunk = EdgeChunk(
+                *(np.zeros_like(f) for f in chunk)
+            )
+            return
+        for name, f, tf in zip(EdgeChunk._fields, chunk, self._template):
+            if f.dtype != tf.dtype or f.shape != tf.shape:
+                raise ValueError(
+                    f"tenant chunk field {name!r} ({f.dtype}{f.shape})"
+                    f" differs from the tier template "
+                    f"({tf.dtype}{tf.shape}) — tenants of a tier must"
+                    " ship identically-shaped chunks"
+                )
+
     def stack_chunks(self, per_lane: list) -> tuple:
         """Host-stack one chunk (or a masked zero chunk) per lane into
         the [lanes, C] batch + the bool[lanes] active mask."""
         first = next((c for c in per_lane if c is not None), None)
         if first is None:
             raise ValueError("stack_chunks needs at least one live lane")
-        if self._template is None:
-            self._template = first
-            self._zero_chunk = EdgeChunk(
-                *(np.zeros_like(f) for f in first)
-            )
-        tmpl = self._template
         for c in per_lane:
-            if c is None:
-                continue
-            for name, f, tf in zip(EdgeChunk._fields, c, tmpl):
-                if f.dtype != tf.dtype or f.shape != tf.shape:
-                    raise ValueError(
-                        f"tenant chunk field {name!r} ({f.dtype}{f.shape})"
-                        f" differs from the tier template "
-                        f"({tf.dtype}{tf.shape}) — tenants of a tier must"
-                        " ship identically-shaped chunks"
-                    )
+            if c is not None:
+                self.check_template(c)
         rows = [c if c is not None else self._zero_chunk for c in per_lane]
         rows += [self._zero_chunk] * (self.lanes - len(per_lane))
         stacked = EdgeChunk(*(np.stack(fs) for fs in zip(*rows)))
@@ -262,13 +271,15 @@ class _Tenant:
 
 class _Tier:
     __slots__ = ("name", "batch", "chunks_in_window", "snapshot",
-                 "snapshot_window", "windows_closed", "last_ckpt_window")
+                 "snapshot_lanes", "snapshot_window", "windows_closed",
+                 "last_ckpt_window")
 
     def __init__(self, name: str, batch: TenantBatch):
         self.name = name
         self.batch = batch
         self.chunks_in_window = 0
         self.snapshot = None  # last closed window's stacked emission
+        self.snapshot_lanes = 0  # stacked width of `snapshot`
         self.snapshot_window = 0
         self.windows_closed = 0
         self.last_ckpt_window = 0
@@ -377,26 +388,25 @@ class MultiTenantEngine:
         if self.checkpoint_dir is not None:
             from .resilience import CheckpointManager
 
-            # Under the dispatch lock: manager construction reaps stale
-            # ``*.npz.tmp`` files in the SHARED directory, which must
-            # not interleave with another tenant's in-flight checkpoint
-            # write (_checkpoint_tier holds the same lock).
-            with self._dispatch_lock:
-                t.manager = CheckpointManager(
-                    self.checkpoint_dir, prefix=tenant_prefix(tenant_id),
-                    async_write=False,
+            # No dispatch lock needed here: manager construction reaps
+            # only THIS tenant's ``<prefix>-*.npz.tmp`` leftovers, and
+            # no writer for a not-yet-admitted tenant's prefix can be
+            # in flight (admit refuses duplicates).
+            t.manager = CheckpointManager(
+                self.checkpoint_dir, prefix=tenant_prefix(tenant_id),
+                async_write=False,
+            )
+            if self.resume:
+                found = t.manager.load_latest(
+                    like=tr.batch.agg.init()
                 )
-                if self.resume:
-                    found = t.manager.load_latest(
-                        like=tr.batch.agg.init()
+                if found is not None:
+                    state, position, _meta, path = found
+                    t.pending_state = jax.tree.map(np.asarray, state)
+                    logger.info(
+                        "tenant %r resuming from %s at chunk %d",
+                        tenant_id, path, position,
                     )
-                    if found is not None:
-                        state, position, _meta, path = found
-                        t.pending_state = jax.tree.map(np.asarray, state)
-                        logger.info(
-                            "tenant %r resuming from %s at chunk %d",
-                            tenant_id, path, position,
-                        )
         source = None
         if chunks is not None:
             from .resilience import _make_seekable
@@ -424,16 +434,21 @@ class MultiTenantEngine:
         return lane
 
     def submit(self, tenant_id, chunk: EdgeChunk) -> None:
-        """Push one chunk onto a tenant's queue (any thread)."""
+        """Push one chunk onto a tenant's queue (any thread). Raises
+        ``ValueError`` to the caller when the chunk doesn't match the
+        tier's template — a malformed chunk must never reach the
+        scheduler's dispatch path, where it would take down every
+        tenant's fold loop."""
         with self._lock:
             t = self._tenants[tenant_id]
             if t.finished:
                 raise ValueError(
                     f"tenant {tenant_id!r} is finished; no more chunks"
                 )
-            cap = self._tiers[t.tier].batch.chunk_capacity
-        h = _normalize_chunk(chunk, cap)
+            batch = self._tiers[t.tier].batch
+        h = _normalize_chunk(chunk, batch.chunk_capacity)
         with self._lock:
+            batch.check_template(h)
             t.queue.append(h)
         self._work.set()
 
@@ -472,14 +487,21 @@ class MultiTenantEngine:
         """Read a tenant's last merge-window snapshot (staleness bound:
         one merge window). ``v`` indexes array snapshots (labels /
         degrees); ``None`` returns the whole row. Returns ``None``
-        before the first window close. Never blocks a window close —
-        the lock is held only to read the snapshot reference."""
+        before the first window close, and for a tenant admitted after
+        it (its lane is not in the stored snapshot). Never blocks a
+        window close — the lock is held only to read the snapshot
+        reference."""
         with self._lock:
             t = self._tenants[tenant_id]
             tier = self._tiers[t.tier]
             snap = tier.snapshot
             lane = t.lane
-        if snap is None:
+            width = tier.snapshot_lanes
+        if snap is None or lane >= width:
+            # A tenant admitted after the snapshot was taken has no
+            # lane in it — and JAX CLAMPS out-of-bounds indices, so
+            # snap[lane] would silently return the highest stacked
+            # lane (another tenant's data) instead of failing.
             return None
         # D2H outside the lock: a slow transfer must not serialize the
         # scheduler's snapshot swap (or other queries).
@@ -496,7 +518,10 @@ class MultiTenantEngine:
         """Window number the tenant's snapshot was taken at (0 = none
         yet) — the query-staleness handle."""
         with self._lock:
-            tier = self._tiers[self._tenants[tenant_id].tier]
+            t = self._tenants[tenant_id]
+            tier = self._tiers[t.tier]
+            if t.lane >= tier.snapshot_lanes:
+                return 0  # tenant admitted after the snapshot was taken
             return tier.snapshot_window
 
     # ------------------------------------------------------------ driving
@@ -558,15 +583,28 @@ class MultiTenantEngine:
                 and not t.queue
             ]
         for t in pulls:
-            chunk = next(t.source, None)
-            if chunk is None:
+            batch = self._tiers[t.tier].batch
+            try:
+                chunk = next(t.source, None)
+                h = (None if chunk is None
+                     else _normalize_chunk(chunk, batch.chunk_capacity))
+                with self._lock:
+                    if h is None:
+                        t.finished = True
+                    else:
+                        batch.check_template(h)
+                        t.queue.append(h)
+            except Exception:
+                # Quarantine: one tenant's bad source/chunk must not
+                # kill the scheduler for every other tenant. The tenant
+                # stops advancing (its folded prefix stays queryable);
+                # everyone else keeps dispatching.
+                logger.exception(
+                    "tenant %r: chunk source failed; quarantining "
+                    "(stream truncated at chunk %d)", t.tid, t.consumed,
+                )
                 with self._lock:
                     t.finished = True
-                continue
-            cap = self._tiers[t.tier].batch.chunk_capacity
-            h = _normalize_chunk(chunk, cap)
-            with self._lock:
-                t.queue.append(h)
 
     def _run(self, until_idle: bool) -> None:
         bus = obs_bus.get_bus()
@@ -640,16 +678,21 @@ class MultiTenantEngine:
                 width = 1 + max((t.lane for t in members), default=-1)
                 per_lane: list = [None] * width
                 took: list = []
-                starved = 0
+                starved_tenants: list = []
                 for t in members:
                     if t.queue:
                         per_lane[t.lane] = t.queue.popleft()
                         took.append(t)
                     elif not t.finished and not t.done:
-                        starved += 1
-                        t.starved_windows += 1
+                        starved_tenants.append(t)
             if not took:
+                # No dispatch, no starvation: a starved window is a
+                # masked no-op lane IN a dispatch, so an idle serving
+                # engine polling empty queues must not inflate the
+                # counters (the increments land below, with the other
+                # post-dispatch accounting).
                 continue
+            starved = len(starved_tenants)
             batch = tier.batch
             t0 = tracer.now() if tracer is not None else 0.0
             with self._dispatch_lock:
@@ -662,6 +705,8 @@ class MultiTenantEngine:
             with self._lock:
                 for t in took:
                     t.consumed += 1
+                for t in starved_tenants:
+                    t.starved_windows += 1
                 self.stats["dispatches"] += 1
                 self.stats["chunks"] += len(took)
                 if starved:
@@ -709,9 +754,14 @@ class MultiTenantEngine:
         tier.chunks_in_window = 0
         tier.windows_closed += 1
         bus.inc("tenants.windows_closed")
+        # Lane bound from the snapshot's OWN leading dim, not
+        # batch.lanes: an admission may widen the batch between the
+        # snapshot compute and this publication.
+        snap_lanes = jax.tree.leaves(snap)[0].shape[0]
         with self._lock:
             self.stats["windows_closed"] += 1
             tier.snapshot = snap
+            tier.snapshot_lanes = snap_lanes
             tier.snapshot_window = tier.windows_closed
         if tracer is not None:
             tracer.span("merge_emit", f"tenants/{tier.name}", t0,
@@ -778,6 +828,7 @@ class MultiTenantEngine:
                 jax.block_until_ready(snap)
             with self._lock:
                 tier.snapshot = snap
+                tier.snapshot_lanes = jax.tree.leaves(snap)[0].shape[0]
 
     def _final_checkpoints(self) -> None:
         if self.checkpoint_dir is None:

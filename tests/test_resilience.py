@@ -101,6 +101,46 @@ def test_checkpoint_manager_skips_torn_newest(tmp_path):
     assert pos == 1 and int(state) == 1 and path != newest
 
 
+def test_stale_tmp_reap_is_prefix_scoped(tmp_path):
+    """Manager construction reaps only ITS rotation's crashed-writer
+    leftovers: another prefix sharing the directory (one rotation per
+    tenant in the multi-tenant engine) may have a write in flight, and
+    a directory-wide reap would delete its tmp mid-write."""
+    mine = tmp_path / "a-000000000005-x1y2.npz.tmp"
+    theirs = tmp_path / "b-000000000009-q3r4.npz.tmp"
+    mine.write_bytes(b"torn leftover")
+    theirs.write_bytes(b"write in flight")
+    CheckpointManager(str(tmp_path), prefix="a", async_write=False)
+    assert not mine.exists()  # own leftover reaped at takeover
+    assert theirs.exists()  # the other rotation's tmp untouched
+    CheckpointManager(str(tmp_path), prefix="b", async_write=False)
+    assert not theirs.exists()
+
+
+def test_checkpoint_tmp_name_matches_reap_scope(tmp_path, monkeypatch):
+    """The atomic-rename tmp carries the target basename, so a crashed
+    writer's leftover globs under its OWN rotation's prefix-scoped
+    reap (an anonymous mkstemp name would never be cleaned up)."""
+    import fnmatch
+
+    from gelly_tpu.engine import checkpoint as ckpt_mod
+
+    seen = []
+    real_mkstemp = ckpt_mod.tempfile.mkstemp
+
+    def spy(**kw):
+        fd, p = real_mkstemp(**kw)
+        seen.append(p)
+        return fd, p
+
+    monkeypatch.setattr(ckpt_mod.tempfile, "mkstemp", spy)
+    mgr = CheckpointManager(str(tmp_path), prefix="t9", async_write=False)
+    mgr.save(np.int64(3), 4)
+    assert seen and fnmatch.fnmatch(
+        os.path.basename(seen[0]), "t9-*.npz.tmp"
+    )
+
+
 def test_checkpoint_manager_async_write_error_surfaces(tmp_path):
     mgr = CheckpointManager(
         str(tmp_path), keep=2,

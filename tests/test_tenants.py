@@ -330,6 +330,102 @@ def test_queries_never_block_window_close():
         assert out[i].tobytes() == want.tobytes()
 
 
+def test_query_for_tenant_admitted_after_snapshot_returns_none():
+    """A tenant admitted AFTER the last window close has no lane in
+    the stored snapshot — query must return None, not CLAMP to the
+    highest stacked lane (JAX out-of-bounds indexing clamps instead of
+    raising, which silently leaked another tenant's row)."""
+    cc = _cc_plan()
+    eng = MultiTenantEngine(merge_every=1)
+    eng.add_tier("cc", cc, CHUNK)
+    eng.admit("a", "cc", chunks=_stream(0))
+    a_row = eng.drain()["a"]
+    assert a_row is not None
+    # "b" lands on lane 1; the snapshot is still the width-1 stack
+    # from the drain above.
+    eng.admit("b", "cc")
+    assert eng.labels("b") is None
+    assert eng.labels("b", 0) is None
+    assert eng.snapshot_window("b") == 0
+    assert eng.labels("a").tobytes() == a_row.tobytes()  # "a" unharmed
+    # Once "b" folds its own stream, queries resolve to b's own data.
+    for c in _stream(7):
+        eng.submit("b", c)
+    eng.finish("b")
+    out = eng.drain()
+    want = np.asarray(_stream(7).aggregate(cc, merge_every=1).result())
+    assert out["b"].tobytes() == want.tobytes()
+
+
+# --------------------------------------------------------------------- #
+# malformed-chunk containment
+
+
+def test_submit_rejects_template_mismatch_to_submitter():
+    """A chunk whose ``val`` dtype diverges from the tier template is
+    rejected AT submit() — were it first caught at stack time it would
+    kill the scheduler thread for every tenant, after the round had
+    already popped (and so dropped) other tenants' chunks."""
+    cc = _cc_plan()
+    eng = MultiTenantEngine(merge_every=1)
+    eng.add_tier("cc", cc, CHUNK)
+    eng.admit("good", "cc")
+    eng.admit("bad", "cc")
+    chunks = list(_stream(3))
+    for c in chunks:
+        eng.submit("good", c)
+    rogue = chunks[0]._replace(
+        val=np.asarray(chunks[0].val, np.float64)
+    )
+    with pytest.raises(ValueError, match="tier template"):
+        eng.submit("bad", rogue)
+    eng.finish("good")
+    eng.finish("bad")
+    out = eng.drain()
+    want = np.asarray(_stream(3).aggregate(cc, merge_every=1).result())
+    assert out["good"].tobytes() == want.tobytes()
+
+
+def test_pull_mode_malformed_chunk_quarantines_one_tenant():
+    """A pull-source tenant shipping a template-mismatched chunk is
+    quarantined (its stream truncated at the bad chunk) — the
+    scheduler survives and every other tenant folds to completion."""
+    cc = _cc_plan()
+    eng = MultiTenantEngine(merge_every=1)
+    eng.add_tier("cc", cc, CHUNK)
+    rogue = [c._replace(val=np.asarray(c.val, np.float64))
+             for c in _stream(5, n_edges=64)]
+    eng.admit("good", "cc", chunks=_stream(0))
+    eng.admit("rogue", "cc", chunks=rogue)
+    out = eng.drain()  # terminates: the bad tenant must not hang it
+    want = np.asarray(_stream(0).aggregate(cc, merge_every=1).result())
+    assert out["good"].tobytes() == want.tobytes()
+    assert eng.position("rogue") == 0  # nothing folded past the reject
+
+
+def test_starved_windows_counts_only_dispatch_rounds():
+    """The counter's unit is 'masked no-op lane IN a dispatch': rounds
+    where nothing dispatched must not bump it (an idle serving engine
+    polling empty queues would otherwise inflate it at the poll
+    rate, diverging from the bus counter)."""
+    cc = _cc_plan()
+    with obs_bus.scope() as bus:
+        eng = MultiTenantEngine(merge_every=1)
+        eng.add_tier("cc", cc, CHUNK)
+        eng.admit("busy", "cc")
+        eng.admit("idle", "cc")
+        for c in _stream(3):  # 96 edges -> 3 chunks -> 3 rounds
+            eng.submit("busy", c)
+        eng.finish("busy")
+        with pytest.raises(RuntimeError, match="never finish"):
+            eng.drain()
+        # Exactly one starved window per DISPATCH round; the empty
+        # round that ended drain() contributes none.
+        assert eng.starved_windows("idle") == 3
+        assert bus.counters["tenants.starved_windows"] == 3
+        assert eng.stats["starved_lanes"] == 3
+
+
 # --------------------------------------------------------------------- #
 # per-tenant checkpoints + resume
 
