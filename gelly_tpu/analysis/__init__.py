@@ -22,13 +22,22 @@ correctness-tooling floor under both:
   declarative protocol-invariant checker for
   ``engine/coordination.py`` (rule ids ``PI0xx``), same suppression
   machinery;
+- :mod:`gelly_tpu.analysis.contracts` — durability-contract checker:
+  exactly-once/durability rules (``EO0xx`` — ack-after-durability,
+  checkpoint-position provenance, atomic-write discipline, rotation
+  ordering), wire-protocol order-of-operations rules (``WP0xx`` — CRC
+  before seq advance, read-only REJECT paths, ack-bounded resend
+  trims), and observability-drift rules (``OB0xx`` — the ``obs/bus.py``
+  glossary must match the package's emitted names exactly), same
+  suppression machinery;
 - :mod:`gelly_tpu.analysis.sanitize` — builds the native components
   under ASan/UBSan (``GELLY_NATIVE_SANITIZE=asan|ubsan``) and drives a
   smoke workload through every fold in an ``LD_PRELOAD``-prepared
   subprocess.
 
 Run everything with ``python -m gelly_tpu.analysis`` (or one tool via
-``python -m gelly_tpu.analysis abi|jitlint|racecheck [paths]``); the
+``python -m gelly_tpu.analysis abi|jitlint|racecheck|contracts
+[paths]``); the
 exit code is non-zero iff any unsuppressed finding exists, and
 ``--format=json`` emits the findings machine-readably for CI. See
 ``--help`` for lane selection.
@@ -37,6 +46,26 @@ exit code is non-zero iff any unsuppressed finding exists, and
 from __future__ import annotations
 
 import dataclasses
+import os
+
+
+def collect_python_files(paths) -> list:
+    """Expand files/dirs into the sorted, de-duplicated absolute ``.py``
+    path list every lint tool walks (``__pycache__`` skipped) — ONE
+    collection rule for jitlint, racecheck and contracts, so a future
+    fix (symlink cycles, encoding filters) lands in one place."""
+    files: list = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirs, filenames in os.walk(p):
+                if "__pycache__" in dirpath:
+                    continue
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        else:
+            files.append(p)
+    return sorted(set(os.path.abspath(f) for f in files))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,4 +85,4 @@ class Finding:
         return s
 
 
-__all__ = ["Finding"]
+__all__ = ["Finding", "collect_python_files"]

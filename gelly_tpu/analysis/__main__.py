@@ -2,9 +2,10 @@
 
 Unified exit-code contract for every analysis tool:
 
-    python -m gelly_tpu.analysis                  # all tools (abi+jitlint+racecheck)
+    python -m gelly_tpu.analysis                  # all tools
     python -m gelly_tpu.analysis --all            # same, explicit
     python -m gelly_tpu.analysis racecheck PATH…  # one tool, optional paths
+    python -m gelly_tpu.analysis contracts PATH…
     python -m gelly_tpu.analysis jitlint
     python -m gelly_tpu.analysis abi
 
@@ -36,6 +37,7 @@ import sys
 
 from . import Finding
 from . import abi as abi_mod
+from . import contracts as contracts_mod
 from . import jitlint as jitlint_mod
 from . import racecheck as racecheck_mod
 from . import sanitize as sanitize_mod
@@ -43,7 +45,7 @@ from . import sanitize as sanitize_mod
 _REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", ".."))
 
-TOOLS = ("abi", "jitlint", "racecheck")
+TOOLS = ("abi", "jitlint", "racecheck", "contracts")
 
 
 def _list_rules() -> str:
@@ -65,6 +67,11 @@ def _list_rules() -> str:
                  "(analysis/racecheck.py), suppress with "
                  "`# graphlint: disable=RCxxx` / `PIxxx`:")
     for rid, (summary, _hint) in sorted(racecheck_mod.RULES.items()):
+        lines.append(f"  {rid}  {summary}")
+    lines.append("durability-contract checker (analysis/contracts.py), "
+                 "suppress with `# graphlint: disable=EOxxx` / `WPxxx` / "
+                 "`OBxxx`:")
+    for rid, (summary, _hint) in sorted(contracts_mod.RULES.items()):
         lines.append(f"  {rid}  {summary}")
     lines.append("sanitizer lane (analysis/sanitize.py): "
                  "--sanitize asan|ubsan, env GELLY_NATIVE_SANITIZE")
@@ -103,18 +110,20 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m gelly_tpu.analysis",
         description="repo-specific static analysis: ABI cross-check of "
-                    "native/*.cc vs ctypes bindings, jit-hazard lint and "
-                    "concurrency race/protocol-invariant check of "
+                    "native/*.cc vs ctypes bindings, jit-hazard lint, "
+                    "concurrency race/protocol-invariant check and "
+                    "durability/wire/observability contract check of "
                     "gelly_tpu/, optional native sanitizer smoke lane. "
-                    "Subcommands: abi | jitlint | racecheck | all "
-                    "(default all).",
+                    "Subcommands: abi | jitlint | racecheck | contracts "
+                    "| all (default all).",
     )
     ap.add_argument("paths", nargs="*",
-                    help="files/dirs to lint (jitlint + racecheck; "
-                         "default ROOT/gelly_tpu)")
+                    help="files/dirs to lint (jitlint + racecheck + "
+                         "contracts; default ROOT/gelly_tpu)")
     ap.add_argument("--all", action="store_true",
-                    help="run every tool (abi+jitlint+racecheck) — the "
-                         "default when no subcommand is given")
+                    help="run every tool (abi+jitlint+racecheck+"
+                         "contracts) — the default when no subcommand "
+                         "is given")
     ap.add_argument("--root", default=_REPO_ROOT,
                     help="repo root (default: the checkout this package "
                          "lives in)")
@@ -133,6 +142,8 @@ def main(argv=None) -> int:
                     help="skip the jit-hazard linter")
     ap.add_argument("--skip-racecheck", action="store_true",
                     help="skip the concurrency race detector")
+    ap.add_argument("--skip-contracts", action="store_true",
+                    help="skip the durability-contract checker")
     ap.add_argument("--format", choices=("text", "json"), default="text",
                     help="output format (json: one machine-readable "
                          "object on stdout, for CI)")
@@ -164,6 +175,8 @@ def main(argv=None) -> int:
         run["jitlint"] = False
     if args.skip_racecheck:
         run["racecheck"] = False
+    if args.skip_contracts:
+        run["contracts"] = False
 
     per_tool: dict[str, list[Finding]] = {}
     if run["abi"]:
@@ -172,6 +185,8 @@ def main(argv=None) -> int:
         per_tool["jitlint"] = jitlint_mod.lint_paths(root, lint_paths)
     if run["racecheck"]:
         per_tool["racecheck"] = racecheck_mod.lint_paths(root, lint_paths)
+    if run["contracts"]:
+        per_tool["contracts"] = contracts_mod.lint_paths(root, lint_paths)
 
     findings = [f for fs in per_tool.values() for f in fs]
     rc = 1 if findings else 0

@@ -66,7 +66,7 @@ import dataclasses
 import os
 import re
 
-from . import Finding
+from . import Finding, collect_python_files
 
 RULES: dict[str, tuple[str, str]] = {
     "GL001": (
@@ -423,18 +423,7 @@ class JitLinter:
     # ---------------------------------------------------------- linting
 
     def lint_paths(self, paths) -> list[Finding]:
-        files: list[str] = []
-        for p in paths:
-            if os.path.isdir(p):
-                for dirpath, _dirnames, filenames in os.walk(p):
-                    if "__pycache__" in dirpath:
-                        continue
-                    files.extend(os.path.join(dirpath, f)
-                                 for f in sorted(filenames)
-                                 if f.endswith(".py"))
-            else:
-                files.append(p)
-        for path in sorted(set(files)):
+        for path in collect_python_files(paths):
             self.lint_file(path)
         return self.findings
 

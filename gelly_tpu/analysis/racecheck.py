@@ -104,7 +104,7 @@ import ast
 import dataclasses
 import os
 
-from . import Finding
+from . import Finding, collect_python_files
 from .jitlint import _attr_chain, suppressed as _line_suppressed
 
 RULES: dict[str, tuple[str, str]] = {
@@ -778,19 +778,7 @@ class RaceChecker:
     # ----------------------------------------------------------- linting
 
     def lint_paths(self, paths) -> list[Finding]:
-        files: list[str] = []
-        for p in paths:
-            if os.path.isdir(p):
-                for dirpath, _d, filenames in os.walk(p):
-                    if "__pycache__" in dirpath:
-                        continue
-                    files.extend(os.path.join(dirpath, f)
-                                 for f in sorted(filenames)
-                                 if f.endswith(".py"))
-            else:
-                files.append(p)
-        files = sorted(set(os.path.abspath(f) for f in files))
-        mods = [self.load(f) for f in files]
+        mods = [self.load(f) for f in collect_python_files(paths)]
         for m in mods:
             self._discover_roots(m)
         self._rc006: list = []

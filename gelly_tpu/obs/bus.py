@@ -50,7 +50,15 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``engine.edges_folded``               valid edges (tracer-enabled runs)
 ``engine.windows_closed``             merge windows closed
 ``engine.window_dirty_rows``          dirty count at last delta close
+``engine.dirty_rows_gathered``        delta-close rows moved (S*bucket),
+                                      cumulative
 ``engine.checkpoint_bytes``           aggregate-path checkpoint bytes
+``engine.throughput.edges``           pipelined-run edges folded (gauge)
+``engine.throughput.edges_per_sec``   running fold rate (gauge)
+``stage.fold_dispatch.busy_s``        per-stage busy seconds at executor
+                                      teardown — one
+                                      ``<prefix>.<stage>.busy_s`` gauge
+                                      per StageTimer stage
 ``pipeline.staged_depth``             compress→H2D queue depth (gauge)
 ``pipeline.h2d_depth``                H2D→fold queue depth (gauge)
 ``tenants.active``                    live (not-done) tenants (gauge)
@@ -64,6 +72,9 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``tenants.checkpoints``               per-tenant checkpoint writes
 ``tenants.checkpoint_bytes``          cumulative tenant ckpt bytes
 ``sharded_cc.window_dirty_rows``      dirty entries at last emission
+``sharded_cc.window_dirty_max_shard`` max per-shard dirty count (gauge)
+``sharded_cc.emissions_dense``        window closes emitting full labels
+``sharded_cc.emissions_sparse``       window closes emitting dirty pairs
 ``sharded_cc.dirty_rows_gathered``    dirty rows pulled D2H, cumulative
 ====================================  =================================
 

@@ -1,0 +1,832 @@
+"""gelly_tpu.analysis.contracts: durability-contract checker.
+
+Every EO/WP/OB rule is exercised BOTH ways — a seeded-violation fixture
+that must flag (line-anchored) and a clean fixture proving the rule's
+exemption paths (ack after the durability write, retired-counter
+provenance, the tmp+fsync+rename helpers, validate-before-prune, the
+CRC-guard-first order, ack-bounded resend trims, the glossary
+round-trip including prefixed wildcard names). Suppression scoping and
+taint-through-rebind are covered explicitly, the repo tip is asserted
+clean (the ISSUE 11 acceptance gate), and each seeded violation flips
+the unified CLI exit code non-zero."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from gelly_tpu.analysis import contracts
+from gelly_tpu.analysis.__main__ import main as analysis_main
+
+pytestmark = pytest.mark.contracts
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+BUS = os.path.join(REPO, "gelly_tpu", "obs", "bus.py")
+
+
+def _lint_src(tmp_path, src, name="fixture_mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return contracts.lint_paths(str(tmp_path), [str(p)])
+
+
+def _lint_files(tmp_path, files):
+    paths = []
+    for name, src in files.items():
+        p = tmp_path / name
+        p.write_text(src)
+        paths.append(str(p))
+    return contracts.lint_paths(str(tmp_path), paths)
+
+
+def _line_of(src, marker):
+    for i, line in enumerate(src.splitlines(), 1):
+        if marker in line:
+            return i
+    raise AssertionError(f"marker {marker!r} not in fixture")
+
+
+# --------------------------------------------------------------------- #
+# repo tip (ISSUE 11 acceptance: zero unsuppressed findings)
+
+def test_contracts_clean_on_repo_tip():
+    findings = contracts.lint_paths(REPO, [os.path.join(REPO, "gelly_tpu")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_tip_glossary_covers_the_pr11_audit_drift():
+    # The first tip audit of this tool found four names PRs 9/10 grew
+    # without documenting (the OB001 drift class); they must stay in
+    # the glossary — and stay EMITTED (deleting the call site without
+    # deleting the entry is the OB002 half of the same regression).
+    with open(BUS) as f:
+        lines = f.read().splitlines()
+    documented = {m.group(1) for m in
+                  (contracts._GLOSSARY_RE.match(ln) for ln in lines) if m}
+    drifted = {"engine.dirty_rows_gathered",
+               "sharded_cc.window_dirty_max_shard",
+               "sharded_cc.emissions_dense",
+               "sharded_cc.emissions_sparse"}
+    assert drifted <= documented
+    c = contracts.ContractChecker(REPO)
+    c.lint_paths([os.path.join(REPO, "gelly_tpu")])
+    emitted = {s.name for s in c._emits if not s.wildcard}
+    assert drifted <= emitted
+    # The prefixed metrics families (utils/metrics.py publish helpers)
+    # were the wildcard half of the same audit: each family needs at
+    # least one representative glossary entry carrying its suffix.
+    for sfx in (".busy_s", ".edges", ".edges_per_sec"):
+        assert any(g.endswith(sfx) for g in c._glossary), sfx
+
+
+def test_tip_glossary_parse_and_emit_discovery_not_vacuous():
+    # The tip-clean assertion above is vacuous if the OB pass saw no
+    # glossary or no call sites: the checker must have parsed the real
+    # table and discovered the runtime's emit surface.
+    c = contracts.ContractChecker(REPO)
+    c.lint_paths([os.path.join(REPO, "gelly_tpu")])
+    assert len(c._glossary) > 40
+    exact = {s.name for s in c._emits if not s.wildcard}
+    assert {"ingest.frames_received", "tenants.dispatches",
+            "coordination.committed", "pipeline.staged_depth"} <= exact
+    # the prefixed publish_checkpoint names ride the wildcard path
+    wild = {s.name for s in c._emits if s.wildcard}
+    assert {".checkpoints", ".checkpoint_bytes"} <= wild
+
+
+# --------------------------------------------------------------------- #
+# EO rules: flag side, line-anchored
+
+EO_SRC = textwrap.dedent('''\
+    import os
+
+    from gelly_tpu.engine.checkpoint import save_checkpoint
+
+
+    def consume(server, chunks, ckpt_mgr, state):
+        for seq, chunk in chunks:
+            state = fold(state, chunk)
+            server.ack(seq + 1)                          # M-EO001
+        ckpt_mgr.save(state, retired_of(chunks))
+
+
+    def serve(engine, ckpt_path, state):
+        save_checkpoint(ckpt_path, state, position=0)
+        return IngestServer(port=0, auto_ack=True)       # M-EO001-AUTO
+
+
+    class Staging:
+        def __init__(self):
+            self._next_seq = 0
+            self.retired = 0
+
+        def bad_snapshot(self, path, state):
+            pos = self._next_seq
+            save_checkpoint(path, state, position=pos)   # M-EO002
+
+        def write_manifest(self, store_dir, obj):
+            with open(store_dir + "/MANIFEST.json", "w") as f:  # M-EO003
+                f.write(str(obj))
+
+        def prune_rotation(self, files, keep):
+            for old in files[:-keep]:
+                os.unlink(old)                           # M-EO004
+''')
+
+
+def test_eo_rules_flag_line_anchored(tmp_path):
+    findings = _lint_src(tmp_path, EO_SRC)
+    got = {(f.rule, f.line) for f in findings}
+    assert got == {
+        ("EO001", _line_of(EO_SRC, "M-EO001")),
+        ("EO001", _line_of(EO_SRC, "M-EO001-AUTO")),
+        ("EO002", _line_of(EO_SRC, "M-EO002")),
+        ("EO003", _line_of(EO_SRC, "M-EO003")),
+        ("EO004", _line_of(EO_SRC, "M-EO004")),
+    }, "\n".join(f.render() for f in findings)
+    for f in findings:
+        assert f.path.endswith("fixture_mod.py") and f.line > 0 and f.hint
+
+
+def test_eo002_taints_through_rebinds(tmp_path):
+    # The GL006 alias discipline: one (or two) rebinds between the
+    # staged counter and the position argument must not launder it.
+    src = textwrap.dedent('''\
+        from gelly_tpu.engine.checkpoint import save_checkpoint
+
+
+        class S:
+            def snap(self, path, state):
+                staged_count = self._pending_chunks
+                pos = staged_count
+                save_checkpoint(path, state, position=pos)   # M
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert [(f.rule, f.line) for f in findings] \
+        == [("EO002", _line_of(src, "# M"))]
+    assert "pending" in findings[0].message or "staged" in findings[0].message
+
+
+def test_eo002_transitive_chase_respects_binding_order(tmp_path):
+    # `pos` captured `retired` BEFORE retired was rebound to the staged
+    # counter: per-edge flow sensitivity must resolve `retired` at the
+    # line where `pos` read it, not at the call line.
+    src = textwrap.dedent('''\
+        from gelly_tpu.engine.checkpoint import save_checkpoint
+
+
+        class S:
+            def snap(self, path, state, retired):
+                pos = retired
+                retired = self._next_seq
+                save_checkpoint(path, state, position=pos)
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_eo002_overwritten_binding_is_clean(tmp_path):
+    # Flow-sensitive per name: only the LATEST binding before the call
+    # reaches it, so the tentative-then-clamp pattern must not flag.
+    src = textwrap.dedent('''\
+        from gelly_tpu.engine.checkpoint import save_checkpoint
+
+
+        class S:
+            def snap(self, path, state, retired):
+                pos = self._next_seq
+                pos = retired
+                save_checkpoint(path, state, position=pos)
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+EO_CLEAN_SRC = textwrap.dedent('''\
+    import os
+
+    from gelly_tpu.engine.checkpoint import (
+        read_checkpoint_header,
+        save_checkpoint,
+    )
+
+
+    def consume_durably(server, chunks, ckpt_mgr, state, retired):
+        for seq, chunk in chunks:
+            state = fold(state, chunk)
+        ckpt_mgr.save(state, retired)
+        server.ack(retired)              # ack AFTER the durability write
+
+
+    def lossy_pipeline(engine):
+        # auto_ack=True with no checkpoint in scope: the documented
+        # lossy-tolerant mode, not a finding.
+        return IngestServer(port=0, auto_ack=True)
+
+
+    def snapshot_retired(path, state, chunks_consumed):
+        pos = chunks_consumed
+        save_checkpoint(path, state, position=pos)
+
+
+    def export_trace(path, payload):
+        with open(path, "w") as f:       # not a durable-store path
+            f.write(payload)
+
+
+    def rotate_rotation(files, keep):
+        header = read_checkpoint_header(files[-1])
+        if header is None:
+            return                       # abort path: newest unreadable
+        for old in files[:-keep]:
+            os.unlink(old)
+''')
+
+
+def test_eo_clean_fixture_produces_zero_findings(tmp_path):
+    findings = _lint_src(tmp_path, EO_CLEAN_SRC)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_eo004_positive_guard_spelling_is_clean(tmp_path):
+    # `if header is not None: <prune>` after the validation is the
+    # positive spelling of the abort path (fall-through aborts).
+    src = textwrap.dedent('''\
+        import os
+
+        from gelly_tpu.engine.checkpoint import read_checkpoint_header
+
+
+        def rotate_rotation(files, keep):
+            header = read_checkpoint_header(files[-1])
+            if header is not None:
+                for old in files[:-keep]:
+                    os.unlink(old)
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_eo004_needs_the_abort_path_not_just_the_read(tmp_path):
+    # Validation without a possible abort between it and the delete is
+    # decoration: the torn newest file would still lose its fallbacks.
+    src = "\n".join(
+        ln for ln in EO_CLEAN_SRC.splitlines()
+        if "if header is None" not in ln and "abort path" not in ln
+    ) + "\n"
+    findings = _lint_src(tmp_path, src)
+    assert [f.rule for f in findings] == ["EO004"]
+
+
+# --------------------------------------------------------------------- #
+# WP rules
+
+WP_SRC = textwrap.dedent('''\
+    from gelly_tpu.ingest import wire
+
+
+    class BadServer:
+        def __init__(self, q):
+            self._next_seq = 0
+            self._q = q
+            self._unacked = {}
+
+        def conn_loop(self, recv, sock):
+            while True:
+                ftype, seq, payload, crc_ok = wire.read_frame_checked(recv)
+                self._q.put((seq, payload))              # M-WP001-STAGE
+                self._next_seq = seq + 1                 # M-WP001-SEQ
+                if not crc_ok:
+                    continue
+
+        def torn(self, recv):
+            try:
+                frame = wire.read_frame(recv)
+            except wire.TruncatedFrame:
+                self._next_seq += 1                      # M-WP002
+            return frame
+
+        def reject_path(self, sock, seq, expect):
+            if seq > expect:
+                sock.sendall(wire.pack_frame(wire.REJECT, expect))
+                self._next_seq = expect                  # M-WP002-REJ
+
+        def on_reject(self):
+            self._unacked.clear()                        # M-WP003
+''')
+
+
+def test_wp_rules_flag_line_anchored(tmp_path):
+    findings = _lint_src(tmp_path, WP_SRC)
+    got = {(f.rule, f.line) for f in findings}
+    assert got == {
+        ("WP001", _line_of(WP_SRC, "M-WP001-STAGE")),
+        ("WP001", _line_of(WP_SRC, "M-WP001-SEQ")),
+        ("WP002", _line_of(WP_SRC, "M-WP002")),
+        ("WP002", _line_of(WP_SRC, "M-WP002-REJ")),
+        ("WP003", _line_of(WP_SRC, "M-WP003")),
+    }, "\n".join(f.render() for f in findings)
+
+
+WP_CLEAN_SRC = textwrap.dedent('''\
+    from gelly_tpu.ingest import wire
+
+
+    class GoodServer:
+        """The ingest/server.py shape: CRC guard first, REJECT paths
+        read-only, resend trims bounded by ack-derived sequences."""
+
+        def __init__(self, q):
+            self._next_seq = 0
+            self._q = q
+            self._unacked = {}
+
+        def conn_loop(self, recv, sock):
+            while True:
+                ftype, seq, payload, crc_ok = wire.read_frame_checked(recv)
+                if not crc_ok:
+                    sock.sendall(wire.pack_frame(wire.REJECT, seq))
+                    continue
+                self._q.put((seq, payload))
+                self._next_seq = seq + 1
+
+        def raising_reader(self, recv):
+            # read_frame verifies the CRC before returning: callers are
+            # exempt from the WP001 guard requirement.
+            ftype, seq, payload = wire.read_frame(recv)
+            self._next_seq = seq + 1
+            return payload
+
+        def on_ack(self, seq):
+            for s in [s for s in self._unacked if s < seq]:
+                del self._unacked[s]
+
+        def rewind(self, server_next):
+            for s in [s for s in self._unacked if s < server_next]:
+                del self._unacked[s]
+''')
+
+
+def test_wp_clean_fixture_produces_zero_findings(tmp_path):
+    findings = _lint_src(tmp_path, WP_CLEAN_SRC)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_wp002_nested_def_in_handler_is_clean(tmp_path):
+    # A nested def inside a wire-exception handler runs LATER, under
+    # its own contract — its body must not be mistaken for a mutation
+    # of the handler path (the same-scope pruning rule).
+    src = textwrap.dedent('''\
+        from gelly_tpu.ingest import wire
+
+
+        class S:
+            def torn(self, recv, defer):
+                try:
+                    frame = wire.read_frame(recv)
+                except wire.TruncatedFrame:
+                    def _later():
+                        self._next_seq += 1
+                    defer(_later)
+                return frame
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_wp001_positive_crc_branch_is_clean(tmp_path):
+    # `if crc_ok: <stage + advance>` dominates the mutations just as
+    # well as the abort-style inverse — the positive spelling must not
+    # flag (the serving-plane refactors are gated on WP001-clean).
+    src = textwrap.dedent('''\
+        from gelly_tpu.ingest import wire
+
+
+        class S:
+            def conn_loop(self, recv, q):
+                while True:
+                    ftype, seq, payload, crc_ok = \\
+                        wire.read_frame_checked(recv)
+                    if crc_ok:
+                        q.put((seq, payload))
+                        self._next_seq = seq + 1
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_wp001_mutation_inside_the_reject_branch_flags(tmp_path):
+    # The canonical violation: advancing/staging on the CRC-failure
+    # path itself. The abort guard must never bless the statements it
+    # exists to abort around.
+    src = textwrap.dedent('''\
+        from gelly_tpu.ingest import wire
+
+
+        class S:
+            def conn_loop(self, recv, q):
+                while True:
+                    ftype, seq, payload, crc_ok = \\
+                        wire.read_frame_checked(recv)
+                    if not crc_ok:
+                        self._next_seq = seq + 1         # M-IN-ABORT
+                        continue
+                    q.put((seq, payload))
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert [(f.rule, f.line) for f in findings] \
+        == [("WP001", _line_of(src, "M-IN-ABORT"))]
+
+
+def test_wp001_positive_guard_polarity(tmp_path):
+    # Both positive-guard spellings of the reject-path mutation must
+    # flag: the else-branch of `if crc_ok:`, and the fall-through after
+    # an `if crc_ok: return` accept path — a positive guard's line
+    # never blesses later statements.
+    src = textwrap.dedent('''\
+        from gelly_tpu.ingest import wire
+
+
+        class S:
+            def with_else(self, recv, q):
+                ftype, seq, payload, crc_ok = \\
+                    wire.read_frame_checked(recv)
+                if crc_ok:
+                    q.put((seq, payload))
+                    return payload
+                else:
+                    self._next_seq = seq + 1             # M-ELSE-ADV
+
+            def fall_through(self, recv, q):
+                ftype, seq, payload, crc_ok = \\
+                    wire.read_frame_checked(recv)
+                if crc_ok:
+                    return payload
+                self._next_seq = seq + 1                 # M-FALL-ADV
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert {(f.rule, f.line) for f in findings} == {
+        ("WP001", _line_of(src, "M-ELSE-ADV")),
+        ("WP001", _line_of(src, "M-FALL-ADV")),
+    }, "\n".join(f.render() for f in findings)
+
+
+def test_wp001_polarity_keys_on_the_crc_name_itself(tmp_path):
+    # A `not` over some OTHER operand must not flip the guard negative
+    # (the duplicate-drop idiom), and a comparison-spelled negation
+    # (`crc_ok == False`) must not read as a positive guard.
+    src = textwrap.dedent('''\
+        from gelly_tpu.ingest import wire
+
+
+        class S:
+            def dedup(self, recv, q, seen):
+                ftype, seq, payload, crc_ok = \\
+                    wire.read_frame_checked(recv)
+                if crc_ok and not (seq in seen):
+                    q.put((seq, payload))                # verified path
+                    self._next_seq = seq + 1
+
+            def compare_spelled(self, recv, q):
+                ftype, seq, payload, crc_ok = \\
+                    wire.read_frame_checked(recv)
+                if crc_ok == False:                      # noqa: E712
+                    self._next_seq = seq + 1             # M-CMP-ADV
+                    return
+                q.put((seq, payload))
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert [(f.rule, f.line) for f in findings] \
+        == [("WP001", _line_of(src, "M-CMP-ADV"))], \
+        "\n".join(f.render() for f in findings)
+
+
+def test_wp001_success_branch_abort_does_not_bless_fall_through(tmp_path):
+    # A `return` on the SUCCESS path (the else of `if not crc_ok:`)
+    # proves nothing about the fall-through, which runs only on CRC
+    # failure — the canonical violation must still flag; the else
+    # branch itself is the verified path and stays clean.
+    src = textwrap.dedent('''\
+        from gelly_tpu.ingest import wire
+
+
+        class S:
+            def conn(self, recv, q, log):
+                ftype, seq, payload, crc_ok = \\
+                    wire.read_frame_checked(recv)
+                if not crc_ok:
+                    log()
+                else:
+                    q.put((seq, payload))                # verified path
+                    return payload
+                self._next_seq = seq + 1                 # M-FALL-BAD
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert [(f.rule, f.line) for f in findings] \
+        == [("WP001", _line_of(src, "M-FALL-BAD"))], \
+        "\n".join(f.render() for f in findings)
+
+
+def test_wp003_flags_unbounded_del(tmp_path):
+    src = WP_CLEAN_SRC.replace(
+        "for s in [s for s in self._unacked if s < seq]:",
+        "for s in list(self._unacked):",
+    )
+    findings = _lint_src(tmp_path, src)
+    assert [f.rule for f in findings] == ["WP003"]
+
+
+def test_wp003_in_flight_bound_is_not_ack_derived(tmp_path):
+    # A trim bounded by the sender's OWN in-flight counter is clear()
+    # spelled as a filter (next_seq is above every buffered frame): the
+    # `seq` suffix alone must not bless it.
+    src = WP_CLEAN_SRC.replace(
+        "for s in [s for s in self._unacked if s < seq]:",
+        "for s in [s for s in self._unacked if s < self._next_seq]:",
+    )
+    findings = _lint_src(tmp_path, src)
+    assert [f.rule for f in findings] == ["WP003"]
+
+
+def test_wp002_flags_reject_in_else_branch(tmp_path):
+    src = textwrap.dedent('''\
+        from gelly_tpu.ingest import wire
+
+
+        class S:
+            def handle(self, sock, crc_ok, expect):
+                if crc_ok:
+                    pass
+                else:
+                    sock.sendall(wire.pack_frame(wire.REJECT, expect))
+                    self._next_seq = expect              # M-ELSE
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert [(f.rule, f.line) for f in findings] \
+        == [("WP002", _line_of(src, "M-ELSE"))]
+
+
+def test_eo003_keyword_mode_and_pathlib_spellings(tmp_path):
+    src = textwrap.dedent('''\
+        from pathlib import Path
+
+
+        def tear(store_dir, ckpt_path, obj):
+            with open(store_dir + "/MANIFEST.json", mode="w") as f:  # M-KW
+                f.write(str(obj))
+            with Path(ckpt_path).open("w") as f:                     # M-PL
+                f.write(str(obj))
+
+
+        def read_side(store_dir):
+            with open(store_dir + "/MANIFEST.json") as f:   # read: clean
+                return f.read()
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert {(f.rule, f.line) for f in findings} == {
+        ("EO003", _line_of(src, "M-KW")),
+        ("EO003", _line_of(src, "M-PL")),
+    }, "\n".join(f.render() for f in findings)
+
+
+def test_ob002_inactive_on_a_partial_package_subset():
+    # Linting only gelly_tpu/obs/ pulls in the glossary but not the
+    # package's emit sites: OB002 must recognize the under-collected
+    # subset and stay silent instead of mass-flagging live entries.
+    findings = contracts.lint_paths(
+        REPO, [os.path.join(REPO, "gelly_tpu", "obs")])
+    assert [f for f in findings if f.rule == "OB002"] == [], \
+        "\n".join(f.render() for f in findings)
+
+
+def test_ob002_uncovered_module_does_not_mask_covered_ones(tmp_path):
+    # Coverage is per glossary MODULE: one bus.py from an un-covered
+    # package (its sibling sources not in the lint set) must not skip
+    # dead-entry checks for a fully-covered one that sorts after it.
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.mkdir()
+    b.mkdir()
+    (a / "bus.py").write_text(
+        '"""G.\n\n``zapp.alive``  emitted\n``zapp.dead``   dead\n"""\n')
+    (a / "mod.py").write_text(
+        'def p(bus):\n    bus.inc("zapp.alive")\n')
+    (b / "bus.py").write_text('"""G.\n\n``aaa.other``  elsewhere\n"""\n')
+    (b / "helper.py").write_text("x = 1\n")  # NOT linted: b uncovered
+    findings = contracts.lint_paths(str(tmp_path), [
+        str(a / "bus.py"), str(a / "mod.py"), str(b / "bus.py")])
+    got = {(f.rule, os.path.basename(os.path.dirname(f.path)))
+           for f in findings}
+    assert got == {("OB002", "a")}, \
+        "\n".join(f.render() for f in findings)
+    assert "zapp.dead" in findings[0].message
+
+
+def test_eo003_hoisted_path_binding_still_flags(tmp_path):
+    # Hoisting the path into a local must not launder the marker: the
+    # scan chases the same assignment chains EO002 does.
+    src = textwrap.dedent('''\
+        def tear(store_dir, obj):
+            target = store_dir + "/MANIFEST.json"
+            with open(target, "w") as f:                 # M-HOIST
+                f.write(str(obj))
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert [(f.rule, f.line) for f in findings] \
+        == [("EO003", _line_of(src, "M-HOIST"))]
+
+
+def test_ob_collection_ignores_non_bus_receivers(tmp_path):
+    # busy_tracker.gauge(...) never touches the bus: substring matching
+    # on "bus" would flag it OB001 and poison OB002/OB003 coverage.
+    bus = '"""Glossary.\n\n``app.frames``      frames seen\n"""\n'
+    mod = textwrap.dedent('''\
+        def publish(bus, busy_tracker, t):
+            bus.inc("app.frames")
+            busy_tracker.gauge("app.latency", t)
+    ''')
+    findings = _lint_files(tmp_path, {"bus.py": bus, "mod.py": mod})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# --------------------------------------------------------------------- #
+# OB rules (glossary in a fixture bus.py; checker keys on the basename)
+
+OB_BUS_SRC = '''\
+"""Mini event bus with a glossary table.
+
+``app.frames``                        frames seen
+``app.depth``                         staging depth (gauge)
+``app.mixed``                         used as counter AND gauge
+``app.dead``                          never emitted anywhere
+"""
+'''
+
+OB_MOD_SRC = textwrap.dedent('''\
+    def publish(bus, depth, prefix):
+        bus.inc("app.frames")
+        bus.gauge("app.depth", depth)
+        bus.inc("app.rogue")                             # M-OB001
+        bus.inc("app.mixed")
+        bus.gauge("app.mixed", depth)                    # M-OB003
+''')
+
+
+def test_ob_rules_flag_line_anchored(tmp_path):
+    findings = _lint_files(tmp_path, {"bus.py": OB_BUS_SRC,
+                                      "mod.py": OB_MOD_SRC})
+    got = {(f.rule, os.path.basename(f.path), f.line) for f in findings}
+    assert got == {
+        ("OB001", "mod.py", _line_of(OB_MOD_SRC, "M-OB001")),
+        ("OB002", "bus.py", _line_of(OB_BUS_SRC, "app.dead")),
+        ("OB003", "mod.py", _line_of(OB_MOD_SRC, "M-OB003")),
+    }, "\n".join(f.render() for f in findings)
+
+
+def test_ob_glossary_round_trip_is_clean(tmp_path):
+    # Every emitted name documented, every documented name emitted —
+    # including a prefix-parameterized f-string name, which must count
+    # as emitting its ``*.suffix`` family (the publish_checkpoint
+    # idiom) rather than flag OB001/OB002.
+    bus = ('"""Glossary.\n'
+           '\n'
+           '``app.frames``      frames seen\n'
+           '``res.checkpoints``  prefix-published checkpoint writes\n'
+           '"""\n')
+    mod = textwrap.dedent('''\
+        def publish(bus, prefix):
+            bus.inc("app.frames")
+            bus.inc(f"{prefix}.checkpoints")
+    ''')
+    findings = _lint_files(tmp_path, {"bus.py": bus, "mod.py": mod})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_ob001_flags_undocumented_wildcard_family(tmp_path):
+    # A prefixed f-string name whose suffix NO glossary entry carries is
+    # the publish_checkpoint-idiom drift class: it must flag, not slip
+    # through the wildcard path undocumented.
+    bus = '"""Glossary.\n\n``app.frames``      frames seen\n"""\n'
+    mod = textwrap.dedent('''\
+        def publish(bus, prefix):
+            bus.inc("app.frames")
+            bus.inc(f"{prefix}.rogue_family")            # M-OB001-WILD
+    ''')
+    findings = _lint_files(tmp_path, {"bus.py": bus, "mod.py": mod})
+    assert [(f.rule, f.line) for f in findings] \
+        == [("OB001", _line_of(mod, "M-OB001-WILD"))]
+    assert ".rogue_family" in findings[0].message
+
+
+def test_ob_glossary_rules_inactive_without_a_glossary_module(tmp_path):
+    # Without the glossary module in the lint set (rule-fixture runs,
+    # partial-path invocations) OB001/OB002 must stay silent instead of
+    # flagging every name as undocumented. OB003 is glossary-FREE by
+    # design (the counter/gauge collision is a property of the call
+    # sites alone), so the mixed name still flags.
+    findings = _lint_src(tmp_path, OB_MOD_SRC, name="mod.py")
+    assert [f.rule for f in findings] == ["OB003"]
+
+
+# --------------------------------------------------------------------- #
+# suppression scoping
+
+def test_suppression_silences_one_rule(tmp_path):
+    src = EO_SRC.replace(
+        "server.ack(seq + 1)                          # M-EO001",
+        "server.ack(seq + 1)  # graphlint: disable=EO001",
+    )
+    findings = _lint_src(tmp_path, src)
+    rules = {f.rule for f in findings}
+    assert "EO001" in rules  # the auto_ack site still flags
+    assert ("EO001", _line_of(src, "disable=EO001")) \
+        not in {(f.rule, f.line) for f in findings}
+    assert {"EO002", "EO003", "EO004"} <= rules  # others survive
+
+
+def test_suppression_all_and_wrong_rule(tmp_path):
+    src = WP_SRC.replace(
+        "self._unacked.clear()                        # M-WP003",
+        "self._unacked.clear()  # graphlint: disable=all",
+    )
+    assert not any(f.rule == "WP003"
+                   for f in _lint_src(tmp_path, src))
+    src2 = WP_SRC.replace(
+        "self._unacked.clear()                        # M-WP003",
+        "self._unacked.clear()  # graphlint: disable=EO001",
+    )
+    assert any(f.rule == "WP003" for f in _lint_src(tmp_path, src2))
+
+
+# --------------------------------------------------------------------- #
+# every seeded violation flips the CLI exit code (ISSUE 11 acceptance)
+
+_RULE_SEEDS = {
+    "EO001": {"mod.py": EO_SRC},
+    "EO002": {"mod.py": EO_SRC},
+    "EO003": {"mod.py": EO_SRC},
+    "EO004": {"mod.py": EO_SRC},
+    "WP001": {"mod.py": WP_SRC},
+    "WP002": {"mod.py": WP_SRC},
+    "WP003": {"mod.py": WP_SRC},
+    "OB001": {"bus.py": OB_BUS_SRC, "mod.py": OB_MOD_SRC},
+    "OB002": {"bus.py": OB_BUS_SRC, "mod.py": OB_MOD_SRC},
+    "OB003": {"bus.py": OB_BUS_SRC, "mod.py": OB_MOD_SRC},
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_RULE_SEEDS))
+def test_seeded_violation_turns_exit_nonzero(tmp_path, rule, capsys):
+    for name, src in _RULE_SEEDS[rule].items():
+        (tmp_path / name).write_text(src)
+    rc = analysis_main(["contracts", str(tmp_path), "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert rule in out
+
+
+# --------------------------------------------------------------------- #
+# CLI exit-code contract
+
+def test_cli_contracts_subcommand_exit_zero_on_tip(capsys):
+    rc = analysis_main(["contracts", os.path.join(REPO, "gelly_tpu"),
+                        "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "contracts: 0 finding(s)" in out
+    assert "analysis clean (contracts)" in out
+
+
+def test_cli_json_format_covers_contracts(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(EO_SRC)
+    rc = analysis_main(["contracts", str(tmp_path), "--root", REPO,
+                        "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["ok"] is False
+    assert payload["total"] == payload["tools"]["contracts"]["count"] == 5
+    f0 = payload["tools"]["contracts"]["findings"][0]
+    assert {"path", "line", "rule", "message", "hint"} <= set(f0)
+
+
+def test_cli_all_includes_contracts(capsys):
+    rc = analysis_main(["--all", "--root", REPO, "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["ok"] is True
+    assert "contracts" in payload["tools"]
+
+
+def test_cli_skip_contracts(capsys):
+    rc = analysis_main(["--all", "--root", REPO, "--skip-contracts",
+                        "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(payload["tools"]) == {"abi", "jitlint", "racecheck"}
+
+
+def test_cli_list_rules_includes_contract_rules(capsys):
+    assert analysis_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("EO001", "EO004", "WP001", "WP003", "OB001", "OB003"):
+        assert rid in out
