@@ -3,9 +3,19 @@
 Usage: python examples/connected_components.py [--checkpoint-dir=DIR]
            [--codec-workers=K] [--h2d-depth=D] [--merge-mode=MODE]
            [--trace-out=PATH] [--shards=S]
+           [--queries=cc,degrees,bipartiteness]
            [--serve=PORT | --connect=HOST:PORT]
            [<edges path> <merge every chunks>]
 Prints (vertex, component) pairs after each merge window.
+
+``--queries=cc,degrees,bipartiteness`` fuses several questions over the
+ONE stream (README "Fused multi-query"): each chunk is staged and
+transferred once and every named query's fold runs in the same
+compiled program — the per-query answers print at end of stream.
+Composable with ``--shards`` and ``--trace-out`` (the trace shows one
+compress/H2D/fold pipeline feeding one ``multiquery/<name>`` track per
+query); the resilient ``--checkpoint-dir`` driver and ``--serve`` are
+single-query paths.
 
 ``--shards=S`` reads the edge file through S sharded byte-range reader
 lanes (``gelly_tpu.ingest``): each lane parses AND compresses its own
@@ -93,6 +103,66 @@ def _connect_main(target, rest):
           f"frames; server acked {cli.acked}")
 
 
+def _multiquery_main(stream, names, merge_every, shards, trace_out):
+    """Fused multi-query run: every named question answered from ONE
+    shared ingest pipeline (one staging pass + one fold dispatch per
+    chunk; README "Fused multi-query")."""
+    import numpy as np
+
+    from gelly_tpu.library.bipartiteness import bipartiteness_query
+    from gelly_tpu.library.connected_components import cc_query
+    from gelly_tpu.library.degrees import degrees_query
+
+    cap = stream.ctx.vertex_capacity
+    builders = {
+        "cc": lambda: cc_query(cap),
+        "degrees": lambda: degrees_query(cap),
+        "bipartiteness": lambda: bipartiteness_query(cap),
+    }
+    unknown = [n for n in names if n not in builders]
+    if unknown:
+        raise SystemExit(
+            f"unknown --queries names {unknown}; supported: "
+            f"{sorted(builders)} (the spanner's per-edge gate is a "
+            "dedicated example, spanner_example.py)"
+        )
+    specs = [builders[n]() for n in names]
+
+    def run():
+        return stream.aggregate(
+            None, queries=specs, merge_every=merge_every,
+            source_provider=True if shards is not None else None,
+        ).result()
+
+    if trace_out is None:
+        final = run()
+    else:
+        from gelly_tpu import obs
+
+        tracer = obs.SpanTracer()
+        with obs.scope() as bus, obs.install(tracer):
+            final = run()
+        trace = obs.write_chrome_trace(trace_out, tracer, bus=bus)
+        print(f"# trace: {len(trace['traceEvents'])} events -> "
+              f"{trace_out} (one multiquery/<name> track per query; "
+              f"trace_id={tracer.trace_id})")
+    for n in names:
+        if n == "cc":
+            for comp in labels_to_components(final["cc"], stream.ctx):
+                print(f"cc {comp[0]}: {comp}")
+        elif n == "degrees":
+            deg = np.asarray(final["degrees"])
+            top = np.argsort(deg)[::-1][:5]
+            top = top[deg[top] > 0]
+            raw = stream.ctx.decode(top)  # slots -> raw vertex ids
+            pairs = [(int(r), int(deg[v]))
+                     for v, r in zip(top.tolist(), raw.tolist())]
+            print(f"degrees top: {pairs}")
+        elif n == "bipartiteness":
+            ok = bool(np.asarray(final["bipartiteness"].ok))
+            print(f"bipartiteness: {'ok' if ok else 'odd cycle found'}")
+
+
 def main(args):
     ckpt_dir = None
     codec_workers = None
@@ -102,6 +172,7 @@ def main(args):
     shards = None
     serve = None
     connect = None
+    queries = None
     rest = []
     for a in args:
         if a.startswith("--checkpoint-dir="):
@@ -116,6 +187,8 @@ def main(args):
             trace_out = a.split("=", 1)[1]
         elif a.startswith("--shards="):
             shards = int(a.split("=", 1)[1])
+        elif a.startswith("--queries="):
+            queries = [q for q in a.split("=", 1)[1].split(",") if q]
         elif a.startswith("--serve="):
             serve = int(a.split("=", 1)[1])
         elif a.startswith("--connect="):
@@ -162,6 +235,15 @@ def main(args):
         stream = stream_from_args(rest,
                                   default_edges=sequence_default_edges())
     merge_every = arg(rest, 1, 4)
+    if queries is not None:
+        if ckpt_dir is not None or serve is not None:
+            raise SystemExit(
+                "--queries runs the fused multi-query executor "
+                "(stream.aggregate(queries=[...])); --checkpoint-dir "
+                "and --serve are single-query paths — drop them"
+            )
+        return _multiquery_main(stream, queries, merge_every, shards,
+                                trace_out)
     agg = connected_components(stream.ctx.vertex_capacity,
                                merge_mode=merge_mode)
 

@@ -18,6 +18,13 @@ from .coordination import (
     ManifestCorruptError,
     MixedEpochError,
 )
+from .multiquery import (
+    MultiQueryPlan,
+    MultiQueryStream,
+    QuerySpec,
+    fuse,
+    run_multiquery,
+)
 from .tenants import (
     MultiTenantEngine,
     TenantBatch,

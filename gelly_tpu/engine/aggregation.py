@@ -78,6 +78,13 @@ class SummaryAggregation:
     # transform is jitted per plan (device transforms, the default); set
     # False for transforms doing host-side / non-traceable work.
     jit_transform: bool = True
+    # True when transform's output may PASS THROUGH leaves of the live
+    # summary unchanged (e.g. a fused multi-query plan whose
+    # transform-less sub-query emits its running state): the accumulate
+    # plan then keeps fold donation OFF, exactly like the transform-less
+    # accumulate plan — a donated next fold would delete the consumer's
+    # held emission out from under it (see the donation contract below).
+    transform_may_alias: bool = False
     merge_stacked: Callable[[Summary], Summary] | None = None
     # Optional ingest codec: ``host_compress(chunk) -> payload`` runs on the
     # prefetch thread and pre-aggregates a chunk into a compact numpy pytree
@@ -426,7 +433,9 @@ def _compiled_plan(agg: SummaryAggregation, m):
     # donated next fold would delete the consumer's held emission out
     # from under it — donation stays off exactly there.
     accum_plan = agg.fold_accumulates and not agg.transient and S == 1
-    donate = () if (accum_plan and agg.transform is None) else (0,)
+    donate = () if (
+        accum_plan and (agg.transform is None or agg.transform_may_alias)
+    ) else (0,)
     if S == 1:
         # Single-shard specialization: the shard_map + collective plumbing
         # is identity at S=1 and only adds dispatch/layout overhead.
@@ -783,6 +792,7 @@ def run_aggregation(
     allowed_lateness: int = 0,
     timer=None,
     source_provider=None,
+    queries=None,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
 
@@ -875,6 +885,17 @@ def run_aggregation(
     composes with the last-retired-chunk rule below: the provider maps
     the single recorded position onto per-shard seek offsets.
 
+    **Fused multi-query execution** (``queries=[...]``): pass a list of
+    query specs instead of ``agg`` and the engine fuses them into ONE
+    plan (``engine/multiquery.py``): each chunk is produced, staged and
+    transferred H2D exactly once and every query's fold runs inside the
+    same compiled program — one fold dispatch per chunk regardless of
+    Q. The returned stream is a
+    :class:`~gelly_tpu.engine.multiquery.MultiQueryStream` (emission
+    dicts keyed by query name + live per-query ``snapshot`` reads with
+    a one-window staleness bound). Merge-every mode only; see the
+    multiquery module docs for fusion eligibility.
+
     **Exactly-once resume — the last-retired-chunk rule**: the recorded
     checkpoint position counts only chunks whose fold was *dispatched*
     (retired from the pipeline); units still in the compress/H2D double
@@ -885,6 +906,24 @@ def run_aggregation(
     rebuilt from the restored summary via ``on_resume``, dropping any
     staged-but-unfolded assignments).
     """
+    if queries is not None:
+        # The fused multi-query entry point: compose the queries into
+        # one MultiQueryPlan (engine/multiquery.py) so every question
+        # rides ONE produce/compress/H2D leg and ONE fold dispatch per
+        # chunk. The emission stream is wrapped in a MultiQueryStream
+        # (live per-query snapshots) at the bottom of this function.
+        if agg is not None:
+            raise ValueError(
+                "pass a single aggregation OR queries=[...], not both "
+                "(queries are fused into one plan by engine.multiquery)"
+            )
+        from .multiquery import fuse
+
+        agg = fuse(queries)
+    if agg is None:
+        raise ValueError("an aggregation is required (or pass queries=[...])")
+    # Normalized QuerySpec tuple of a fused plan; None for plain plans.
+    fused = getattr(agg, "queries", None) or None
     if merge_every is not None and window_ms is not None:
         raise ValueError("pass at most one of merge_every / window_ms")
     if allowed_lateness and window_ms is None:
@@ -971,6 +1010,29 @@ def run_aggregation(
         prefetch_depth = max(2, ingest_workers)
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)
+    if fused:
+        if window_ms is not None:
+            raise ValueError(
+                f"fused plan '{agg.name}' is merge_every-only: per-query "
+                "cadences (QuerySpec.every) count chunks, and event-time "
+                "windows cannot mask the shared fused fold per query"
+            )
+        if host_precombine is not None:
+            raise ValueError(
+                "host_precombine rewrites the shared chunk for ONE "
+                "query's benefit; a fused plan folds EVERY query from "
+                "the same chunk — drop it (fold the pre-combine into "
+                "that query's own fold instead)"
+            )
+        if S > 1 and any(not q.accum or q.every != 1 for q in fused):
+            raise ValueError(
+                f"fused plan '{agg.name}' carries a non-accumulating "
+                "query (or a per-query merge window > 1): its in-fold "
+                "merges are per-partition, so the fused plan is "
+                f"single-shard — run on a 1-device mesh (S={S} here); "
+                "scale out by sharding the TENANT axis via "
+                "MultiTenantEngine(mesh=...) instead"
+            )
     plan = _compiled_plan(agg, m)
     (fold_step, merge_locals, merger_step, locals0_fn,
      transform_fn, fold_many, fold_codec, delta_count_fn,
@@ -1033,6 +1095,12 @@ def run_aggregation(
         # always on; it is only touched at unit/window cadence.
         tracer = obs_tracing.active_tracer()
         bus = obs_bus.get_bus()
+        # Per-query span attribution for fused plans: every fold span
+        # names the queries riding the dispatch (the MultiQueryStream
+        # wrapper adds the per-query window tracks).
+        fold_attrs = (
+            {"queries": ",".join(q.name for q in fused)} if fused else {}
+        )
         hb = None
         meter = None
         if tracer is not None:
@@ -1629,7 +1697,7 @@ def run_aggregation(
                     bus.inc("engine.chunks_folded", k)
                     if tracer is not None:
                         tracer.span("fold", "fold", t_fold, unit=seq,
-                                    chunks=k, edges=edges)
+                                    chunks=k, edges=edges, **fold_attrs)
                         if edges:
                             meter.record(edges)
                             bus.inc("engine.edges_folded", edges)
@@ -1718,4 +1786,8 @@ def run_aggregation(
     out_stream = SummaryStream(gen)
     out_stream.stats = stats
     out_stream.timer = timer
+    if fused:
+        from .multiquery import MultiQueryStream
+
+        out_stream = MultiQueryStream(out_stream, agg)
     return out_stream

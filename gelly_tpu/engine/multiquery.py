@@ -1,0 +1,420 @@
+"""Fused multi-query execution: one shared ingest pipeline, N questions.
+
+The r05 capture shows the wall is ingest, not compute: host compress
+5.36s + H2D 2.51s against a 0.0009s fold dispatch on
+``streaming_cc_large``. Every additional aggregation folded over the
+*same* edge stream is therefore nearly free — if it shares the
+produce/compress/H2D leg instead of re-running it. That sharing is the
+reference's own execution model (one ``SimpleEdgeStream``, many
+summaries: CC, degrees, bipartiteness, spanner — PAPER.md §L1), and the
+natural serving shape for "millions of users asking different questions
+of one traffic stream".
+
+:func:`fuse` composes Q heterogeneous :class:`~gelly_tpu.engine.
+aggregation.SummaryAggregation` plans into ONE
+:class:`MultiQueryPlan` — itself a ``SummaryAggregation`` whose summary
+is a dict of per-query summaries (plus a fold-step counter leaf). The
+fused fold applies every query's fold to the SAME chunk inside one
+compiled program, so the whole engine carries it unchanged:
+
+- **Pipelined executor**: each chunk is produced, staged and
+  transferred H2D exactly once; the fold dispatch count per chunk is 1
+  regardless of Q (``run_aggregation(queries=[...])`` or
+  ``stream.aggregate(None, queries=[...])``).
+- **Per-query merge windows**: a non-accumulating query (e.g. the
+  spanner, whose cross-window merge is the reference's
+  ``CombineSpanners``) carries ``{local, global}`` sub-state and its
+  merge runs INSIDE the fused fold as a masked no-op sub-fold — the
+  same ``jnp.where``-select machinery as the tenant engine's masked
+  lanes — firing only when the query's own ``QuerySpec.every`` window
+  closes. Accumulating queries (CC forests, degree vectors, parity
+  forests) carry one running summary, exactly like their standalone
+  accumulate plans.
+- **Checkpointing**: the fused state is one pytree, so the engine's
+  existing exactly-once machinery snapshots every query's leaves in
+  ONE rotation at ONE position (the last-retired-chunk rule; the step
+  counter rides the same snapshot, so masked merge windows resume
+  bit-identically — ``tests/_multiquery_crash_child.py`` proves it
+  under SIGKILL).
+- **Live snapshots** (:class:`MultiQueryStream`): per-query reads off
+  the last closed window's emission dict, staleness bounded by one
+  merge window, lock held only for the reference swap.
+- **Multi-tenant tiers**: a ``MultiQueryPlan`` is a valid tier plan for
+  :class:`~gelly_tpu.engine.tenants.MultiTenantEngine` — N tenants
+  × Q queries ride one vmapped donated dispatch.
+
+Fusion eligibility (refused loudly at :func:`fuse` time):
+
+- plans folding only through a stateful host codec
+  (``requires_codec`` / ``stack_ordered``) — their per-run id sessions
+  cannot ride a shared raw-chunk fold;
+- ``transient`` plans — their emit-and-reset window contract needs the
+  engine's Merger path, which the fused accumulate plan bypasses;
+- host-side transforms (``jit_transform=False``) — fused emissions are
+  one jitted dict program;
+- mismatched chunk schemas: queries declaring different
+  ``slot_capacity`` read the same shared chunk, and a query built for
+  a smaller slot space would silently mis-index it (JAX clamps);
+- per-query ``every`` > 1 on an accumulating plan (no merge window to
+  defer) and duplicate / reserved query names.
+
+Per-query codecs (``host_compress``) are deliberately NOT engaged: the
+fused pipeline stages each chunk once for every query, so the fused
+fold is the RAW fold composition — build sub-plans with
+``ingest_combine=False`` (the library ``*_query`` helpers do).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import bus as obs_bus
+from ..obs import tracing as obs_tracing
+from .aggregation import SummaryAggregation, SummaryStream
+
+# Reserved leaf: the fused fold's chunk counter (drives the masked
+# per-query merge windows; rides the checkpoint like any other leaf).
+STEP_KEY = "_step"
+
+
+class QuerySpec(NamedTuple):
+    """One query riding the fused plan.
+
+    - ``name`` — key of this query's summary/emission in the fused
+      dicts (unique per plan; ``"_step"`` is reserved).
+    - ``agg`` — the query's ``SummaryAggregation`` (raw fold; see the
+      module eligibility rules).
+    - ``every`` — merge-window cadence in CHUNKS for non-accumulating
+      plans: the query's ``combine(local, global)`` sub-fold fires on
+      every ``every``-th fused fold and is a masked no-op otherwise.
+      Must be 1 for accumulating plans (nothing to defer).
+    - ``slot_capacity`` — optional declared vertex slot-space size;
+      ``fuse`` refuses to mix differing declared capacities (the
+      queries read the same shared chunk).
+    """
+
+    name: str
+    agg: SummaryAggregation
+    every: int = 1
+    slot_capacity: int | None = None
+
+    @property
+    def accum(self) -> bool:
+        return self.agg.fold_accumulates and not self.agg.transient
+
+
+@dataclasses.dataclass(eq=False)
+class MultiQueryPlan(SummaryAggregation):
+    """The fused plan: a ``SummaryAggregation`` over the dict-of-
+    summaries state, built by :func:`fuse`. ``queries`` holds the
+    normalized :class:`QuerySpec` tuple; everything else is the
+    standard plugin contract, so the engine (and the tenant engine)
+    need no new physical plan."""
+
+    queries: tuple = ()
+
+    @property
+    def query_names(self) -> tuple:
+        return tuple(q.name for q in self.queries)
+
+
+def _as_spec(q) -> QuerySpec:
+    if isinstance(q, QuerySpec):
+        return q
+    if isinstance(q, SummaryAggregation):
+        return QuerySpec(name=q.name, agg=q)
+    if isinstance(q, tuple) and len(q) == 2:
+        return QuerySpec(name=q[0], agg=q[1])
+    raise ValueError(
+        f"cannot fuse {type(q).__name__}: pass a QuerySpec, a "
+        "SummaryAggregation, or a (name, aggregation) pair"
+    )
+
+
+def fuse(queries, *, name: str | None = None) -> MultiQueryPlan:
+    """Stack Q heterogeneous aggregations into one fused plan.
+
+    ``queries`` — iterable of :class:`QuerySpec` /
+    ``SummaryAggregation`` / ``(name, aggregation)`` pairs. Returns a
+    :class:`MultiQueryPlan` whose fold advances EVERY query from the
+    same chunk in one compiled program; run it through
+    ``run_aggregation(queries=...)`` (which wraps the emission stream
+    in a :class:`MultiQueryStream`) or hand it to
+    ``MultiTenantEngine.add_tier`` as a tier plan.
+    """
+    specs = [_as_spec(q) for q in queries]
+    if not specs:
+        raise ValueError("fuse needs at least one query")
+    seen: set = set()
+    caps: dict = {}
+    for q in specs:
+        if not isinstance(q.agg, SummaryAggregation):
+            raise ValueError(
+                f"query {q.name!r}: agg must be a SummaryAggregation, "
+                f"got {type(q.agg).__name__}"
+            )
+        if isinstance(q.agg, MultiQueryPlan):
+            raise ValueError(
+                f"query {q.name!r} is already a fused MultiQueryPlan — "
+                "pass its sub-queries instead of nesting fusions"
+            )
+        if not q.name or q.name == STEP_KEY:
+            raise ValueError(
+                f"query name {q.name!r} is empty or reserved "
+                f"({STEP_KEY!r} is the fused step-counter leaf)"
+            )
+        if q.name in seen:
+            raise ValueError(f"duplicate query name {q.name!r}")
+        seen.add(q.name)
+        if q.agg.requires_codec or q.agg.stack_ordered:
+            raise ValueError(
+                f"query {q.name!r} ({q.agg.name}) folds through a "
+                "stateful host codec (requires_codec/stack_ordered); "
+                "the fused plan folds the shared RAW chunk — build the "
+                "query without the ordered codec (e.g. "
+                "ingest_combine=False)"
+            )
+        if q.agg.transient:
+            raise ValueError(
+                f"query {q.name!r} ({q.agg.name}) is transient "
+                "(emit-and-reset windows); the fused accumulate plan "
+                "has no per-window Merger to reset through — un-fusable"
+            )
+        if q.agg.transform is not None and not q.agg.jit_transform:
+            raise ValueError(
+                f"query {q.name!r} ({q.agg.name}) uses a host-side "
+                "transform (jit_transform=False); fused emissions are "
+                "one jitted dict program — un-fusable"
+            )
+        if not isinstance(q.every, int) or q.every < 1:
+            raise ValueError(
+                f"query {q.name!r}: every must be an int >= 1, got "
+                f"{q.every!r}"
+            )
+        if q.accum and q.every != 1:
+            raise ValueError(
+                f"query {q.name!r} ({q.agg.name}) accumulates "
+                "(fold_accumulates); it has no merge window to defer — "
+                "every must be 1"
+            )
+        if q.slot_capacity is not None:
+            caps[q.name] = int(q.slot_capacity)
+    if len(set(caps.values())) > 1:
+        raise ValueError(
+            "mismatched chunk schemas: fused queries read the SAME "
+            "shared chunk but declare different slot capacities "
+            f"({caps}) — a query built for a smaller slot space would "
+            "silently mis-index it (JAX clamps out-of-range ids)"
+        )
+    specs = tuple(specs)
+    plan_name = name or "multiquery(" + "+".join(q.name for q in specs) + ")"
+
+    def init():
+        st: dict = {STEP_KEY: jnp.zeros((), jnp.int64)}
+        for q in specs:
+            if q.accum:
+                st[q.name] = q.agg.init()
+            else:
+                st[q.name] = {"local": q.agg.init(),
+                              "global": q.agg.init()}
+        return st
+
+    def fold(state, chunk):
+        step = state[STEP_KEY] + 1
+        out: dict = {STEP_KEY: step}
+        for q in specs:
+            if q.accum:
+                out[q.name] = q.agg.fold(state[q.name], chunk)
+                continue
+            sub = state[q.name]
+            local = q.agg.fold(sub["local"], chunk)
+            # The per-query merge window as a masked no-op sub-fold
+            # (the tenant engine's masked-lane machinery): the merge
+            # is computed every chunk but SELECTED in only when this
+            # query's own window closes — one program, no host
+            # branching, vmap-safe under a tenant tier.
+            boundary = (step % q.every) == 0
+            merged = q.agg.combine(local, sub["global"])
+            fresh = q.agg.init()
+            out[q.name] = {
+                "local": jax.tree.map(
+                    lambda f, l: jnp.where(boundary, f, l), fresh, local
+                ),
+                "global": jax.tree.map(
+                    lambda m, g: jnp.where(boundary, m, g),
+                    merged, sub["global"],
+                ),
+            }
+        return out
+
+    def combine(a, b):
+        # Cross-partition merge of fused states (per-query combine,
+        # component-wise over the local/global sub-states). Sound for
+        # accumulating sub-queries only — which is exactly the shape
+        # run_aggregation admits at S > 1 (non-accum queries are
+        # refused there: their in-fold merges are per-partition).
+        out: dict = {STEP_KEY: jnp.maximum(a[STEP_KEY], b[STEP_KEY])}
+        for q in specs:
+            if q.accum:
+                out[q.name] = q.agg.combine(a[q.name], b[q.name])
+            else:
+                out[q.name] = {
+                    "local": q.agg.combine(a[q.name]["local"],
+                                           b[q.name]["local"]),
+                    "global": q.agg.combine(a[q.name]["global"],
+                                            b[q.name]["global"]),
+                }
+        return out
+
+    def transform(state):
+        out: dict = {}
+        for q in specs:
+            if q.accum:
+                view = state[q.name]
+            else:
+                # Merge-on-read: the emission always includes the
+                # un-merged window tail, matching the standalone
+                # plan's close-at-emission semantics (at a boundary,
+                # local is freshly reset and combine(init, g) == g by
+                # the Merger identity contract).
+                view = q.agg.combine(state[q.name]["local"],
+                                     state[q.name]["global"])
+            if q.agg.transform is not None:
+                out[q.name] = q.agg.transform(view)
+            else:
+                out[q.name] = view
+        return out
+
+    fused_flatten = None
+    if any(q.agg.flatten is not None for q in specs):
+        def fused_flatten(state):
+            out: dict = {STEP_KEY: state[STEP_KEY]}
+            for q in specs:
+                f = q.agg.flatten
+                if q.accum:
+                    out[q.name] = (f(state[q.name]) if f is not None
+                                   else state[q.name])
+                elif f is not None:
+                    out[q.name] = {
+                        "local": f(state[q.name]["local"]),
+                        "global": f(state[q.name]["global"]),
+                    }
+                else:
+                    out[q.name] = state[q.name]
+            return out
+
+    return MultiQueryPlan(
+        init=init,
+        fold=fold,
+        combine=combine,
+        transform=transform,
+        flatten=fused_flatten,
+        # The fused plan presents as ONE accumulating summary: per-query
+        # windowing (for non-accum sub-queries) happens inside the fold,
+        # so the engine's single-running-state physical plan carries
+        # every query with zero per-window Merger work of its own.
+        fold_accumulates=True,
+        transient=False,
+        jit_transform=True,
+        # An accumulating sub-query without a transform passes its live
+        # state leaves through the fused emission — the engine must not
+        # donate buffers an emission may still alias (the same rule as
+        # its transform-less accumulate plan).
+        transform_may_alias=any(
+            q.accum and q.agg.transform is None for q in specs
+        ),
+        fold_backend="fused",
+        merge_mode="replicated",
+        name=plan_name,
+        queries=specs,
+    )
+
+
+class MultiQueryStream(SummaryStream):
+    """Emission stream of a fused run + live per-query snapshot reads.
+
+    Iterating yields the fused emission dict (``{query_name:
+    emission}``) once per closed merge window, exactly like
+    ``SummaryStream``. While a consumer drives the stream,
+    :meth:`snapshot` answers per-query reads from the LAST yielded
+    window — staleness bounded by one merge window — from any thread;
+    the lock is held only for the reference swap, never for D2H.
+    """
+
+    def __init__(self, inner: SummaryStream, plan: MultiQueryPlan):
+        self._inner = inner
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._latest = None
+        self._window = 0
+        super().__init__(self._gen)
+        self.stats = getattr(inner, "stats", None)
+        self.timer = getattr(inner, "timer", None)
+
+    def _gen(self):
+        bus = obs_bus.get_bus()
+        tracer = obs_tracing.active_tracer()
+        names = self.plan.query_names
+        bus.gauge("multiquery.fused_queries", len(names))
+        bus.inc("multiquery.runs")
+        it = iter(self._inner)
+        while True:
+            t0 = tracer.now() if tracer is not None else 0.0
+            try:
+                out = next(it)
+            except StopIteration:
+                return
+            with self._lock:
+                self._latest = out
+                self._window += 1
+                w = self._window
+            bus.inc("multiquery.emissions", len(names))
+            if tracer is not None:
+                # Per-query attribution: one span per query per window
+                # on its own multiquery/<name> track, covering the
+                # window's wall — the exported trace shows the single
+                # compress/H2D/fold pipeline feeding Q query tracks.
+                for n in names:
+                    tracer.span("multiquery", f"multiquery/{n}", t0,
+                                query=n, window=w)
+            yield out
+
+    def snapshot(self, query: str | None = None):
+        """Host copy of the named query's last-window emission (or the
+        whole ``{name: emission}`` dict with ``query=None``). Returns
+        ``None`` before the first window close."""
+        with self._lock:
+            latest = self._latest
+        if latest is None:
+            return None
+        obs_bus.get_bus().inc("multiquery.snapshot_reads")
+        if query is None:
+            return {n: jax.tree.map(np.asarray, latest[n])
+                    for n in self.plan.query_names}
+        if query not in latest:
+            raise ValueError(
+                f"unknown query {query!r} (fused: "
+                f"{list(self.plan.query_names)})"
+            )
+        return jax.tree.map(np.asarray, latest[query])
+
+    def snapshot_window(self) -> int:
+        """Merge-window number :meth:`snapshot` currently answers from
+        (0 = none closed yet) — the staleness handle."""
+        with self._lock:
+            return self._window
+
+
+def run_multiquery(queries, stream, **runner_kw) -> MultiQueryStream:
+    """Convenience front end: ``run_aggregation(None, stream,
+    queries=queries, **runner_kw)`` — one shared ingest pipeline, every
+    query answered per chunk."""
+    from .aggregation import run_aggregation
+
+    return run_aggregation(None, stream, queries=queries, **runner_kw)

@@ -183,6 +183,36 @@ class TenantBatch:
         self.plan = plan
         self.lanes = width
 
+    def shrink(self, keep_lanes: list, width: int) -> None:
+        """Rebuild the stack at a SMALLER width (idle-lane reclamation):
+        row ``keep_lanes[i]`` of the old stack lands in lane ``i``;
+        every other lane is dropped — callers snapshot evicted lanes
+        FIRST (the engine parks evicted tenants' final rows host-side
+        so their queries keep answering). Shrinking back to a width
+        the growth path already compiled is a plan-cache hit."""
+        if width >= self.lanes or width < len(keep_lanes):
+            raise ValueError(
+                f"shrink width {width} must be < current {self.lanes} "
+                f"and >= the {len(keep_lanes)} kept lanes"
+            )
+        plan = _compiled_tenant_plan(self.agg, width, mesh=self.mesh)
+        idx = np.asarray(keep_lanes, np.int32)
+
+        def compact(old):
+            fresh = plan.init()
+            if old is None or idx.size == 0:
+                return fresh
+            rows = jax.tree.map(lambda l: l[idx], old)
+            return jax.tree.map(
+                lambda f, r: f.at[: idx.size].set(r), fresh, rows
+            )
+
+        self.state = compact(self.state)
+        if not self.accum:
+            self.global_ = compact(self.global_)
+        self.plan = plan
+        self.lanes = width
+
     def set_lane(self, lane: int, host_state) -> None:
         """Overwrite one lane's RUNNING summary from a host pytree
         (checkpoint resume). For non-accumulate plans the restored
@@ -247,7 +277,7 @@ class _Tenant:
 
     __slots__ = ("tid", "tier", "lane", "queue", "source", "consumed",
                  "finished", "done", "starved_windows", "manager",
-                 "pending_state", "ready")
+                 "pending_state", "ready", "parked", "parked_window")
 
     def __init__(self, tid, tier: str, lane: int):
         self.tid = tid
@@ -261,6 +291,12 @@ class _Tenant:
         self.starved_windows = 0
         self.manager = None
         self.pending_state = None  # host pytree awaiting lane write
+        # Idle-lane reclamation evicted this tenant's lane: `parked`
+        # holds its final snapshot row host-side (queries answer from
+        # it; `lane` becomes -1), `parked_window` the window it was
+        # taken at.
+        self.parked = None
+        self.parked_window = 0
         # False until admit() has installed the lane state and resume
         # position: a running scheduler must neither pull nor dispatch
         # a half-admitted tenant (it would fold into a fresh lane the
@@ -272,7 +308,7 @@ class _Tenant:
 class _Tier:
     __slots__ = ("name", "batch", "chunks_in_window", "snapshot",
                  "snapshot_lanes", "snapshot_window", "windows_closed",
-                 "last_ckpt_window")
+                 "last_ckpt_window", "hw_active", "low_windows")
 
     def __init__(self, name: str, batch: TenantBatch):
         self.name = name
@@ -283,6 +319,11 @@ class _Tier:
         self.snapshot_window = 0
         self.windows_closed = 0
         self.last_ckpt_window = 0
+        # Idle-lane reclamation bookkeeping: per-window high-water of
+        # LIVE (not-done) lane occupants, and how many consecutive
+        # closed windows that high-water stayed below width/2.
+        self.hw_active = 0
+        self.low_windows = 0
 
 
 class MultiTenantEngine:
@@ -308,13 +349,27 @@ class MultiTenantEngine:
     def __init__(self, *, merge_every: int = 1,
                  checkpoint_dir: str | None = None,
                  checkpoint_every: int = 1, resume: bool = False,
-                 mesh=None, poll_s: float = 0.005):
+                 mesh=None, poll_s: float = 0.005,
+                 reclaim_after: int | None = None):
         if merge_every < 1:
             raise ValueError(f"merge_every must be >= 1, got {merge_every}")
         if checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}"
             )
+        if reclaim_after is not None and reclaim_after < 1:
+            raise ValueError(
+                f"reclaim_after must be >= 1 windows, got {reclaim_after}"
+            )
+        # Idle-lane reclamation (None = off): when a tier's high-water
+        # LIVE lane count stays below width/2 for `reclaim_after`
+        # consecutive closed windows, the stack halves — done tenants'
+        # lanes are evicted (their state snapshotted + final-
+        # checkpointed first; queries keep answering from the parked
+        # row) and live tenants compact into the low lanes. Lane
+        # widths previously only grew (O(log N) compiles); shrinking
+        # back to a compiled width is a plan-cache hit.
+        self.reclaim_after = reclaim_after
         self.merge_every = merge_every
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
@@ -335,7 +390,8 @@ class MultiTenantEngine:
         # the server's RESUME poll spinning forever.
         self.publish_staged_gauge = False
         self.stats = {"dispatches": 0, "chunks": 0, "windows_closed": 0,
-                      "starved_lanes": 0}
+                      "starved_lanes": 0, "reclaims": 0,
+                      "lanes_reclaimed": 0}
 
     # ------------------------------------------------------------ control
 
@@ -375,8 +431,12 @@ class MultiTenantEngine:
                     f"unknown tier {tier!r} (registered: "
                     f"{sorted(self._tiers)})"
                 )
-            lane = sum(
-                1 for t in self._tenants.values() if t.tier == tier
+            # Next free lane: 1 + the highest OCCUPIED lane (evicted
+            # tenants hold lane -1, so reclaimed widths are reused by
+            # later admissions instead of growing the stack forever).
+            lane = 1 + max(
+                (t.lane for t in self._tenants.values()
+                 if t.tier == tier), default=-1,
             )
             t = _Tenant(tenant_id, tier, lane)
             self._tenants[tenant_id] = t
@@ -497,7 +557,14 @@ class MultiTenantEngine:
             snap = tier.snapshot
             lane = t.lane
             width = tier.snapshot_lanes
-        if snap is None or lane >= width:
+            parked = t.parked if lane < 0 else None
+        if parked is not None:
+            # Evicted by idle-lane reclamation: the final snapshot row
+            # was parked host-side before the lane was reclaimed.
+            if v is None:
+                return jax.tree.map(np.asarray, parked)
+            return jax.tree.map(lambda l: np.asarray(l)[v], parked)
+        if snap is None or lane < 0 or lane >= width:
             # A tenant admitted after the snapshot was taken has no
             # lane in it — and JAX CLAMPS out-of-bounds indices, so
             # snap[lane] would silently return the highest stacked
@@ -520,6 +587,8 @@ class MultiTenantEngine:
         with self._lock:
             t = self._tenants[tenant_id]
             tier = self._tiers[t.tier]
+            if t.lane < 0:
+                return t.parked_window  # evicted: the parked row's window
             if t.lane >= tier.snapshot_lanes:
                 return 0  # tenant admitted after the snapshot was taken
             return tier.snapshot_window
@@ -670,8 +739,14 @@ class MultiTenantEngine:
             with self._lock:
                 members = [
                     t for t in self._tenants.values()
-                    if t.tier == tier.name and t.ready
+                    if t.tier == tier.name and t.ready and t.lane >= 0
                 ]
+                # Reclamation high-water: LIVE lane occupants this round
+                # (window-scoped; _maybe_reclaim reads and resets it).
+                tier.hw_active = max(
+                    tier.hw_active,
+                    sum(1 for t in members if not t.done),
+                )
                 # Index by LANE, not member order: a half-admitted
                 # neighbor (ready=False) must leave its lane masked,
                 # never shift another tenant's chunk into it.
@@ -770,6 +845,8 @@ class MultiTenantEngine:
                 and tier.windows_closed - tier.last_ckpt_window
                 >= self.checkpoint_every):
             self._checkpoint_tier(tier)
+        if self.reclaim_after is not None:
+            self._maybe_reclaim(tier, bus, tracer)
 
     def _checkpoint_tier(self, tier: _Tier) -> None:
         batch = tier.batch
@@ -788,6 +865,7 @@ class MultiTenantEngine:
                 members = [
                     (t, t.consumed) for t in self._tenants.values()
                     if t.tier == tier.name and t.manager is not None
+                    and t.lane >= 0
                 ]
             for t, position in members:
                 t.manager.save(
@@ -800,6 +878,124 @@ class MultiTenantEngine:
                     t.manager.path_for(position),
                 )
         tier.last_ckpt_window = tier.windows_closed
+
+    def _maybe_reclaim(self, tier: _Tier, bus, tracer) -> None:
+        """Idle-lane reclamation (called at every window close when
+        ``reclaim_after`` is set): halve the tier's lane stack once the
+        high-water LIVE lane count has stayed below width/2 for
+        ``reclaim_after`` consecutive windows. Evicted (done) tenants'
+        rows are snapshotted host-side — and final-checkpointed when a
+        manager exists — BEFORE the stack is rebuilt, so their queries
+        keep answering; live tenants compact into the low lanes."""
+        batch = tier.batch
+        with self._lock:
+            members = [t for t in self._tenants.values()
+                       if t.tier == tier.name]
+            live_cnt = sum(1 for t in members
+                           if not t.done and t.lane >= 0)
+            hw = tier.hw_active
+            tier.hw_active = live_cnt  # restart at the current floor
+            width = batch.lanes
+            target = batch._width_for(max(width // 2, live_cnt, 1))
+            shrinkable = batch.plan is not None and target < width
+            if shrinkable and 2 * hw < width:
+                tier.low_windows += 1
+            else:
+                tier.low_windows = 0
+            due = shrinkable and tier.low_windows >= self.reclaim_after
+            if due:
+                tier.low_windows = 0
+        if not due:
+            return
+        with self._dispatch_lock:
+            # Re-collect under the dispatch lock: an admission may have
+            # widened/occupied lanes since the decision above.
+            with self._lock:
+                members = [t for t in self._tenants.values()
+                           if t.tier == tier.name]
+                if any(t.lane >= 0 and not t.ready for t in members):
+                    # A half-admitted tenant holds a lane index admit()
+                    # is still working against (its resume state lands
+                    # under the dispatch lock, its readiness under the
+                    # table lock — in that order): compacting lanes now
+                    # would remap or drop the lane out from under it.
+                    # Admission inserts the tenant (ready=False) in the
+                    # same locked write that assigns the lane, so a
+                    # reclaim seeing a consistent table here can never
+                    # interleave with one — defer to the next window.
+                    return
+                live = sorted(
+                    (t for t in members if not t.done and t.lane >= 0),
+                    key=lambda t: t.lane,
+                )
+                evicted = [t for t in members if t.done and t.lane >= 0]
+                width = batch.lanes
+                target = batch._width_for(max(width // 2, len(live), 1))
+                if batch.plan is None or target >= width:
+                    return
+            # Evicted lanes' state is snapshotted FIRST: the parked row
+            # answers queries after the lane is gone, and the final
+            # checkpoint makes the evicted tenant's exactly-once resume
+            # point durable at its last dispatched chunk.
+            src = batch.state if batch.accum else batch.global_
+            snap = batch.plan.snapshot(src)
+            jax.block_until_ready(snap)
+            parked = {
+                t.tid: jax.tree.map(
+                    lambda l, _ln=t.lane: np.asarray(l[_ln]), snap
+                )
+                for t in evicted
+            }
+            for t in evicted:
+                if t.manager is not None:
+                    t.manager.save(
+                        batch.slice_lane(t.lane), t.consumed,
+                        meta={"tenant": str(t.tid), "tier": tier.name,
+                              "window": tier.windows_closed,
+                              "evicted": True},
+                    )
+            keep_lanes = [t.lane for t in live]
+            batch.shrink(keep_lanes, target)
+            # Published snapshot rebuilt in the NEW lane order (fresher
+            # than the last close, never staler), swapped in with the
+            # lane remap in ONE locked write so queries never see a
+            # remapped lane against the old stacked order.
+            new_snap = None
+            if keep_lanes:
+                idx = np.asarray(keep_lanes)
+                new_snap = jax.tree.map(lambda l: l[idx], snap)
+                jax.block_until_ready(new_snap)
+            freed = width - target
+            with self._lock:
+                for i, t in enumerate(live):
+                    t.lane = i
+                for t in evicted:
+                    t.parked = parked[t.tid]
+                    t.parked_window = tier.windows_closed
+                    t.lane = -1
+                if new_snap is not None:
+                    tier.snapshot = new_snap
+                    tier.snapshot_lanes = len(keep_lanes)
+                    tier.snapshot_window = tier.windows_closed
+                else:
+                    # No live lanes kept: the old snapshot's lane order
+                    # is meaningless now, and a later admission at lane
+                    # 0 must not read an evicted tenant's row from it.
+                    tier.snapshot = None
+                    tier.snapshot_lanes = 0
+                self.stats["reclaims"] += 1
+                self.stats["lanes_reclaimed"] += freed
+        bus.inc("tenants.reclaims")
+        bus.inc("tenants.lanes_reclaimed", freed)
+        logger.info(
+            "tier %r reclaimed %d idle lanes (width %d -> %d, %d "
+            "evicted, %d live)", tier.name, freed, width, target,
+            len(evicted), len(live),
+        )
+        if tracer is not None:
+            tracer.instant("tenants.reclaim", tier=tier.name,
+                           width=target, freed=freed,
+                           evicted=len(evicted))
 
     def _flush_partial(self, bus, tracer) -> None:
         with self._lock:

@@ -1,18 +1,25 @@
 from .bipartiteness import (
     BipartitenessResult,
     bipartiteness_check,
+    bipartiteness_query,
     to_candidates,
 )
 from .connected_components import (
     CCSummary,
+    cc_query,
     connected_components,
     connected_components_tree,
     labels_to_components,
 )
-from .degrees import degree_distribution, sharded_degrees
+from .degrees import (
+    degree_aggregate,
+    degree_distribution,
+    degrees_query,
+    sharded_degrees,
+)
 from .iterative_cc import IterativeCCStream
 from .matching import weighted_matching
-from .spanner import host_spanner, spanner, spanner_edges
+from .spanner import host_spanner, spanner, spanner_edges, spanner_query
 from .triangles import (
     exact_triangle_count,
     sampled_triangle_count,

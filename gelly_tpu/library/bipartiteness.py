@@ -259,6 +259,21 @@ def bipartiteness_check(vertex_capacity: int,
     )
 
 
+def bipartiteness_query(vertex_capacity: int, *,
+                        name: str = "bipartiteness"):
+    """Fuse-compatible bipartiteness query (``engine.multiquery.fuse``):
+    the raw parity-union fold (``ingest_combine=False`` — see
+    :func:`~gelly_tpu.library.connected_components.cc_query` for the
+    shared-chunk rationale)."""
+    from ..engine.multiquery import QuerySpec
+
+    return QuerySpec(
+        name=name,
+        agg=bipartiteness_check(vertex_capacity, ingest_combine=False),
+        slot_capacity=vertex_capacity,
+    )
+
+
 def to_candidates(result: BipartitenessResult, ctx):
     """Render the reference's observable: (success, {component: {vertex:
     sign}}) with sign True for the root's color side — the Candidates

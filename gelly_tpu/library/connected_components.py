@@ -888,6 +888,24 @@ def connected_components(
     )
 
 
+def cc_query(vertex_capacity: int, *, name: str = "cc",
+             merge: str = "gather", fold_backend: str = "auto"):
+    """Fuse-compatible CC query (``engine.multiquery.fuse``): the raw
+    fold (``ingest_combine=False`` — the fused pipeline stages each
+    chunk exactly once for EVERY query, so per-query codecs never
+    engage), tagged with this plan's slot capacity so ``fuse`` can
+    refuse mismatched chunk schemas."""
+    from ..engine.multiquery import QuerySpec
+
+    return QuerySpec(
+        name=name,
+        agg=connected_components(vertex_capacity, merge=merge,
+                                 ingest_combine=False,
+                                 fold_backend=fold_backend),
+        slot_capacity=vertex_capacity,
+    )
+
+
 def connected_components_tree(vertex_capacity: int,
                               degree: int | None = None) -> SummaryAggregation:
     """ConnectedComponentsTree parity alias (merge-tree combine).

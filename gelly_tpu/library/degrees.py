@@ -175,6 +175,24 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
     )
 
 
+def degrees_query(vertex_capacity: int, *, name: str = "degrees",
+                  count_out: bool = True, count_in: bool = True):
+    """Fuse-compatible degree query (``engine.multiquery.fuse``): the
+    raw ±1-scatter fold (``ingest_combine=False`` — see
+    :func:`~gelly_tpu.library.connected_components.cc_query` for the
+    shared-chunk rationale). ``count_out``/``count_in`` pick the
+    direction, so e.g. out- and in-degree can ride one fused dispatch
+    as two named queries."""
+    from ..engine.multiquery import QuerySpec
+
+    return QuerySpec(
+        name=name,
+        agg=degree_aggregate(vertex_capacity, count_out=count_out,
+                             count_in=count_in, ingest_combine=False),
+        slot_capacity=vertex_capacity,
+    )
+
+
 def _sum_deltas(ids: np.ndarray, deltas: np.ndarray):
     """Sum deltas by vertex id, dropping zero nets. Accumulates in the
     deltas dtype — callers summing across chunks pass i64."""

@@ -639,6 +639,31 @@ def spanner(vertex_capacity: int, k: int,
     )
 
 
+def spanner_query(vertex_capacity: int, k: int, *, name: str = "spanner",
+                  every: int = 1, max_edges: int | None = None,
+                  max_degree: int | None = None,
+                  gate_batch: int | None = None):
+    """Fuse-compatible k-spanner query (``engine.multiquery.fuse``).
+
+    The spanner is the one non-accumulating plan of the library quartet:
+    its cross-window merge is the reference's ``CombineSpanners``
+    re-gate, so the fused plan carries ``{local, global}`` sub-state and
+    runs that merge INSIDE the fused fold as a masked no-op sub-fold
+    firing every ``every`` chunks — the per-query merge window. Its
+    emission is ``combine(local, global)`` (merge-on-read), so the
+    window tail is always included, exactly matching the standalone
+    plan's close-at-emission semantics."""
+    from ..engine.multiquery import QuerySpec
+
+    return QuerySpec(
+        name=name,
+        agg=spanner(vertex_capacity, k, max_edges=max_edges,
+                    max_degree=max_degree, gate_batch=gate_batch),
+        every=every,
+        slot_capacity=vertex_capacity,
+    )
+
+
 class HostSpannerStream:
     """Centralized native host spanner — the fast path for the
     order-dependent fold (the weighted-matching precedent: a strictly

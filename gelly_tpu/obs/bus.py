@@ -71,6 +71,17 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``tenants.windows_closed``            tenant merge windows closed
 ``tenants.checkpoints``               per-tenant checkpoint writes
 ``tenants.checkpoint_bytes``          cumulative tenant ckpt bytes
+``tenants.reclaims``                  idle-lane reclamation events
+                                      (tier lane stack halved)
+``tenants.lanes_reclaimed``           lanes freed by idle-lane
+                                      reclamation, cumulative
+``multiquery.runs``                   fused multi-query runs started
+``multiquery.fused_queries``          queries riding the active fused
+                                      plan (gauge)
+``multiquery.emissions``              per-query emissions published
+                                      (Q per window close)
+``multiquery.snapshot_reads``         live per-query snapshot reads
+                                      answered
 ``sharded_cc.window_dirty_rows``      dirty entries at last emission
 ``sharded_cc.window_dirty_max_shard`` max per-shard dirty count (gauge)
 ``sharded_cc.emissions_dense``        window closes emitting full labels

@@ -1,0 +1,81 @@
+"""Subprocess body for the fused multi-query kill -9 crash test
+(test_multiquery.py).
+
+Runs the FULL pipelined engine path over a fused 3-query plan (CC +
+degrees + spanner-with-its-own-merge-window), checkpointing the fused
+state — every query's leaves plus the fold-step counter in ONE file at
+ONE position — and throttled so the kill lands with units in flight.
+The second incarnation resumes and must produce per-query emissions
+bit-identical to an uninterrupted run: the single recorded position
+covers every query at once, and the restored step counter replays the
+masked per-query merge windows at exactly the chunks the golden run
+merged at.
+
+argv: <checkpoint_path> <out_npz> [emit_sleep_seconds]
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gelly_tpu import edge_stream_from_edges  # noqa: E402
+from gelly_tpu.engine.aggregation import run_aggregation  # noqa: E402
+from gelly_tpu.engine.checkpoint import save_checkpoint  # noqa: E402
+from gelly_tpu.library.connected_components import cc_query  # noqa: E402
+from gelly_tpu.library.degrees import degrees_query  # noqa: E402
+from gelly_tpu.library.spanner import spanner_query  # noqa: E402
+
+N_EDGES = int(os.environ.get("GELLY_MQ_EDGES", "1024"))
+N_V = int(os.environ.get("GELLY_MQ_NV", "96"))
+CHUNK = int(os.environ.get("GELLY_MQ_CHUNK", "32"))
+
+
+def build_stream():
+    rng = np.random.default_rng(29)
+    pairs = rng.integers(0, N_V, (N_EDGES, 2))
+    return edge_stream_from_edges(
+        [(int(a), int(b)) for a, b in pairs],
+        vertex_capacity=N_V, chunk_size=CHUNK,
+    )
+
+
+def build_queries():
+    return [
+        cc_query(N_V),
+        degrees_query(N_V),
+        # The non-accumulating query: its merge window (every=2) rides
+        # the checkpointed step counter — a resume that restarted the
+        # counter would merge at the wrong chunks and diverge.
+        spanner_query(N_V, k=2, every=2),
+    ]
+
+
+def main(argv):
+    ckpt_path, out_path = argv[0], argv[1]
+    sleep_s = float(argv[2]) if len(argv) > 2 else 0.0
+    res = run_aggregation(
+        None, build_stream(), queries=build_queries(),
+        merge_every=2, fold_batch=2,
+        checkpoint_path=ckpt_path, checkpoint_every=1,
+        resume=os.path.exists(ckpt_path),
+        codec_workers=2, h2d_depth=2,
+    )
+    final = None
+    for final in res:
+        if sleep_s:
+            # Throttled consumer: the staging/H2D legs run ahead, so the
+            # parent's SIGKILL lands with units in flight.
+            time.sleep(sleep_s)
+    import jax
+
+    host = jax.tree.map(np.asarray, final)
+    save_checkpoint(out_path, host, position=res.stats["chunks"])
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -67,3 +67,21 @@ def test_connected_components_components():
 def test_matching_total_weight():
     out = run_main("centralized_weighted_matching")
     assert "total weight:" in out
+
+
+def test_connected_components_fused_queries():
+    # --queries fuses CC + degrees + bipartiteness over the one default
+    # stream: the same odd/even components as the single-query run, a
+    # degree line, and bipartiteness ok (the odd and even chains are
+    # paths — no odd cycles).
+    out = run_main("connected_components",
+                   ["--queries=cc,degrees,bipartiteness"])
+    assert "cc 1: [1, 3, 5" in out
+    assert "cc 2: [2, 4, 6" in out
+    assert "degrees top:" in out
+    assert "bipartiteness: ok" in out
+    with pytest.raises(SystemExit, match="single-query"):
+        run_main("connected_components",
+                 ["--queries=cc", "--checkpoint-dir=/tmp/x"])
+    with pytest.raises(SystemExit, match="unknown --queries"):
+        run_main("connected_components", ["--queries=nope"])

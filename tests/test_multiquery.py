@@ -1,0 +1,461 @@
+"""Fused multi-query execution (``gelly_tpu/engine/multiquery.py``).
+
+The acceptance contract: every library plan folded FUSED produces
+summaries bit-identical to its standalone run on adversarial streams
+(hot vertex, self-loops, odd cycles), one fold dispatch advances all Q
+queries per chunk, un-fusable plans are refused loudly, the fused
+checkpoint (one position, every query's leaves in one file) resumes
+exactly-once — including under SIGKILL with units in flight (crash
+child) — and live per-query snapshots answer with a one-window
+staleness bound.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gelly_tpu import edge_stream_from_edges
+from gelly_tpu.engine.aggregation import (
+    SummaryAggregation,
+    run_aggregation,
+)
+from gelly_tpu.engine.multiquery import (
+    MultiQueryPlan,
+    MultiQueryStream,
+    QuerySpec,
+    fuse,
+    run_multiquery,
+)
+from gelly_tpu.library.bipartiteness import bipartiteness_query
+from gelly_tpu.library.connected_components import (
+    cc_query,
+    connected_components,
+)
+from gelly_tpu.library.degrees import degrees_query
+from gelly_tpu.library.spanner import spanner_query
+from gelly_tpu.obs import bus as obs_bus
+from gelly_tpu.parallel import mesh as mesh_lib
+
+pytestmark = pytest.mark.multiquery
+
+N_V = 96
+CHUNK = 32
+
+
+def _adversarial_edges():
+    """Hot vertex + self-loops + an odd cycle (bipartiteness's hard
+    case) + an even cycle + random pairs; slots >= 90 stay unseen."""
+    edges = [(1, 2), (2, 3), (3, 1)]  # odd cycle
+    edges += [(4, 5), (5, 6), (6, 7), (7, 4)]  # even cycle
+    edges += [(0, 0), (9, 9)]  # self-loops
+    edges += [(0, v) for v in range(20, 44)]  # hot vertex 0
+    rng = np.random.default_rng(41)
+    edges += [(int(a), int(b)) for a, b in rng.integers(10, 90, (96, 2))]
+    return edges
+
+
+def _stream(edges=None, chunk=CHUNK):
+    return edge_stream_from_edges(
+        edges if edges is not None else _adversarial_edges(),
+        vertex_capacity=N_V, chunk_size=chunk,
+    )
+
+
+def _mesh1():
+    return mesh_lib.make_mesh(1)
+
+
+def _kw(**over):
+    kw = dict(mesh=_mesh1(), ingest_workers=0, prefetch_depth=0,
+              h2d_depth=0)
+    kw.update(over)
+    return kw
+
+
+def _quartet():
+    return [
+        cc_query(N_V),
+        degrees_query(N_V),
+        bipartiteness_query(N_V),
+        spanner_query(N_V, k=2, every=2),
+    ]
+
+
+def _assert_tree_identical(want, got, label):
+    wl, gl = jax.tree.leaves(want), jax.tree.leaves(got)
+    assert len(wl) == len(gl), label
+    for w, g in zip(wl, gl):
+        w, g = np.asarray(w), np.asarray(g)
+        assert w.dtype == g.dtype, (label, w.dtype, g.dtype)
+        assert w.shape == g.shape, (label, w.shape, g.shape)
+        assert w.tobytes() == g.tobytes(), f"{label}: summary diverged"
+
+
+def _dummy_agg(**over):
+    kw = dict(
+        init=lambda: jnp.zeros((4,), jnp.int32),
+        fold=lambda s, c: s,
+        combine=lambda a, b: a + b,
+        name="dummy",
+    )
+    kw.update(over)
+    return SummaryAggregation(**kw)
+
+
+# --------------------------------------------------------------------- #
+# fused-vs-standalone parity (the library quartet)
+
+
+def test_fused_quartet_bit_identical_to_standalone():
+    """All four library plans fused into ONE plan over the adversarial
+    stream: every per-query summary bit-identical to that plan's
+    standalone run (the spanner's every=2 merge window matching the
+    standalone run's merge_every=2)."""
+    queries = _quartet()
+    fused_final = run_aggregation(
+        None, _stream(), queries=queries, merge_every=2, **_kw()
+    ).result()
+    assert sorted(fused_final) == [
+        "bipartiteness", "cc", "degrees", "spanner",
+    ]
+    for q in queries:
+        want = run_aggregation(
+            q.agg, _stream(), merge_every=2, **_kw()
+        ).result()
+        _assert_tree_identical(want, fused_final[q.name], q.name)
+
+
+def test_fused_emission_matches_every_window_not_just_final():
+    """Window-by-window parity: the fused emission stream yields the
+    same per-query values at every close as the standalone runs."""
+    queries = [cc_query(N_V), degrees_query(N_V)]
+    fused = list(run_aggregation(
+        None, _stream(), queries=queries, merge_every=2, **_kw()
+    ))
+    for q in queries:
+        alone = list(run_aggregation(
+            q.agg, _stream(), merge_every=2, **_kw()
+        ))
+        assert len(alone) == len(fused)
+        for i, (w, f) in enumerate(zip(alone, fused)):
+            _assert_tree_identical(w, f[q.name], f"{q.name}@{i}")
+
+
+def test_per_query_merge_window_decouples_from_engine_cadence():
+    """A non-accum query's merge window (every=2) fires at its own
+    chunk cadence regardless of the engine's emission cadence: fused
+    at merge_every=1 still matches standalone at merge_every=2."""
+    sp = spanner_query(N_V, k=2, every=2)
+    fused_final = run_aggregation(
+        None, _stream(), queries=[cc_query(N_V), sp], merge_every=1,
+        **_kw()
+    ).result()
+    want = run_aggregation(
+        sp.agg, _stream(), merge_every=2, **_kw()
+    ).result()
+    _assert_tree_identical(want, fused_final["spanner"], "spanner")
+
+
+def test_fused_accumulating_queries_ride_a_sharded_mesh():
+    """All-accumulating fused plans (every=1) are admitted at S > 1 and
+    stay bit-identical to their standalone sharded runs."""
+    queries = [cc_query(N_V), degrees_query(N_V)]
+    m = mesh_lib.make_mesh()  # the conftest 8-virtual-device mesh
+    fused_final = run_aggregation(
+        None, _stream(), queries=queries, merge_every=2, mesh=m,
+        ingest_workers=0, prefetch_depth=0, h2d_depth=0,
+    ).result()
+    for q in queries:
+        want = run_aggregation(
+            q.agg, _stream(), merge_every=2, mesh=m,
+            ingest_workers=0, prefetch_depth=0, h2d_depth=0,
+        ).result()
+        _assert_tree_identical(want, fused_final[q.name], q.name)
+
+
+def test_fused_through_the_sharded_source_provider(tmp_path):
+    """The fused plan rides the sharded byte-range readers: one staging
+    pass per chunk feeds every query, parity with the inline run."""
+    from gelly_tpu.ingest import edge_stream_from_sharded_file
+
+    path = tmp_path / "edges.txt"
+    path.write_text(
+        "".join(f"{a} {b}\n" for a, b in _adversarial_edges())
+    )
+    def provider_stream():
+        return edge_stream_from_sharded_file(
+            str(path), vertex_capacity=N_V, shards=2, chunk_size=CHUNK,
+        )
+
+    queries = [cc_query(N_V), degrees_query(N_V)]
+    fused_final = run_aggregation(
+        None, provider_stream(), queries=queries, merge_every=2,
+        mesh=_mesh1(), source_provider=True,
+    ).result()
+    # Parity against each query's STANDALONE run through the same
+    # provider (the reader lanes' chunking differs from the inline
+    # stream's, so the oracle must share it).
+    for q in queries:
+        want = run_aggregation(
+            q.agg, provider_stream(), merge_every=2,
+            mesh=_mesh1(), source_provider=True,
+        ).result()
+        _assert_tree_identical(want, fused_final[q.name], q.name)
+
+
+# --------------------------------------------------------------------- #
+# fusion eligibility / refusals
+
+
+def test_fuse_refuses_stateful_codec_plans():
+    compact = connected_components(N_V, codec="compact",
+                                   compact_capacity=N_V)
+    with pytest.raises(ValueError, match="stateful host codec"):
+        fuse([cc_query(N_V), QuerySpec("compact", compact)])
+    with pytest.raises(ValueError, match="stateful host codec"):
+        fuse([QuerySpec("ordered", _dummy_agg(stack_ordered=True))])
+    with pytest.raises(ValueError, match="stateful host codec"):
+        fuse([QuerySpec("codec", _dummy_agg(requires_codec=True))])
+
+
+def test_fuse_refuses_transient_and_host_transforms():
+    with pytest.raises(ValueError, match="transient"):
+        fuse([QuerySpec("t", _dummy_agg(transient=True))])
+    with pytest.raises(ValueError, match="host-side"):
+        fuse([QuerySpec("h", _dummy_agg(transform=lambda s: s,
+                                        jit_transform=False))])
+
+
+def test_fuse_refuses_mismatched_chunk_schemas():
+    with pytest.raises(ValueError, match="mismatched chunk schemas"):
+        fuse([cc_query(64), degrees_query(128)])
+
+
+def test_fuse_refuses_bad_names_windows_and_nesting():
+    with pytest.raises(ValueError, match="at least one"):
+        fuse([])
+    with pytest.raises(ValueError, match="duplicate"):
+        fuse([cc_query(N_V), cc_query(N_V)])
+    with pytest.raises(ValueError, match="reserved"):
+        fuse([QuerySpec("_step", _dummy_agg())])
+    with pytest.raises(ValueError, match="every"):
+        fuse([QuerySpec("s", _dummy_agg(), every=0)])
+    # an accumulating plan has no merge window to defer
+    with pytest.raises(ValueError, match="accumulates"):
+        fuse([QuerySpec("acc", _dummy_agg(fold_accumulates=True),
+                        every=2)])
+    inner = fuse([cc_query(N_V)])
+    with pytest.raises(ValueError, match="nesting"):
+        fuse([QuerySpec("outer", inner)])
+
+
+def test_run_aggregation_fused_arg_validation():
+    with pytest.raises(ValueError, match="not both"):
+        run_aggregation(_dummy_agg(), _stream(),
+                        queries=[cc_query(N_V)], **_kw())
+    with pytest.raises(ValueError, match="required"):
+        run_aggregation(None, _stream(), **_kw())
+    with pytest.raises(ValueError, match="merge_every-only"):
+        run_aggregation(None, _stream(), queries=[cc_query(N_V)],
+                        window_ms=100, **_kw())
+    with pytest.raises(ValueError, match="host_precombine"):
+        run_aggregation(None, _stream(), queries=[cc_query(N_V)],
+                        host_precombine=lambda c: c, **_kw())
+    # non-accum queries (in-fold merges are per-partition) refuse S > 1
+    with pytest.raises(ValueError, match="single-shard"):
+        run_aggregation(
+            None, _stream(),
+            queries=[cc_query(N_V), spanner_query(N_V, k=2)],
+            merge_every=2, mesh=mesh_lib.make_mesh(),
+            ingest_workers=0, prefetch_depth=0, h2d_depth=0,
+        )
+
+
+# --------------------------------------------------------------------- #
+# exactly-once checkpoint / resume
+
+
+def test_fused_checkpoint_resume_bit_identical(tmp_path):
+    """One position + every query's leaves (including the step counter
+    driving the spanner's merge window) in one checkpoint: an
+    interrupted fused run resumed mid-stream finishes bit-identical to
+    the uninterrupted run."""
+    queries = [cc_query(N_V), spanner_query(N_V, k=2, every=2)]
+    golden = run_aggregation(
+        None, _stream(), queries=queries, merge_every=2, **_kw()
+    ).result()
+    ck = str(tmp_path / "mq.npz")
+    it = iter(run_aggregation(
+        None, _stream(), queries=queries, merge_every=2,
+        checkpoint_path=ck, checkpoint_every=1, **_kw()
+    ))
+    next(it)
+    next(it)  # the window-1 checkpoint lands when the generator resumes
+    it.close()
+    assert os.path.exists(ck)
+    from gelly_tpu.engine.checkpoint import read_checkpoint_header
+
+    pos = read_checkpoint_header(ck)["position"]
+    assert 0 < pos < len(list(_stream()))  # genuinely mid-stream
+    resumed = run_aggregation(
+        None, _stream(), queries=queries, merge_every=2,
+        checkpoint_path=ck, checkpoint_every=1, resume=True, **_kw()
+    ).result()
+    for name in ("cc", "spanner"):
+        _assert_tree_identical(golden[name], resumed[name], name)
+
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_multiquery_crash_child.py")
+
+
+def _spawn(ckpt, out, sleep_s):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # single default CPU device is enough
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(ckpt), str(out), str(sleep_s)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_fused_kill9_resume_bit_identical(tmp_path):
+    """SIGKILL with units in flight: the resumed fused run's per-query
+    emissions are bit-identical to an unkilled run — the one recorded
+    position covers every query at once."""
+    from gelly_tpu.engine.checkpoint import load_checkpoint
+
+    ckpt = tmp_path / "mq-ck.npz"
+    out_clean = tmp_path / "clean.npz"
+    out_resumed = tmp_path / "resumed.npz"
+
+    p = _spawn(tmp_path / "clean-ck.npz", out_clean, 0.0)
+    assert p.wait(timeout=300) == 0
+
+    p = _spawn(ckpt, out_resumed, 0.05)
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if p.poll() is not None:
+            pytest.fail(f"child exited early (rc={p.returncode})")
+        if ckpt.exists():
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("no checkpoint appeared before the deadline")
+    os.kill(p.pid, signal.SIGKILL)
+    assert p.wait(timeout=60) == -signal.SIGKILL
+    assert not out_resumed.exists()
+
+    _, pos, _ = load_checkpoint(str(ckpt))
+    import _multiquery_crash_child as child
+
+    total = -(-child.N_EDGES // child.CHUNK)
+    assert 0 < pos < total  # mid-stream position
+
+    p = _spawn(ckpt, out_resumed, 0.0)
+    assert p.wait(timeout=300) == 0
+    resumed, _, _ = load_checkpoint(str(out_resumed))
+    clean, _, _ = load_checkpoint(str(out_clean))
+    assert len(resumed) == len(clean)
+    for r, c in zip(resumed, clean):
+        assert r.dtype == c.dtype
+        assert r.tobytes() == c.tobytes()
+
+
+# --------------------------------------------------------------------- #
+# live snapshots + observability
+
+
+def test_live_snapshots_one_window_staleness():
+    queries = [cc_query(N_V), degrees_query(N_V)]
+    with obs_bus.scope() as bus:
+        res = run_multiquery(queries, _stream(), merge_every=2, **_kw())
+        assert isinstance(res, MultiQueryStream)
+        assert res.snapshot() is None and res.snapshot_window() == 0
+        seen = []
+        for i, out in enumerate(iter(res)):
+            assert res.snapshot_window() == i + 1
+            snap = res.snapshot("cc")
+            np.testing.assert_array_equal(snap, np.asarray(out["cc"]))
+            both = res.snapshot()
+            assert sorted(both) == ["cc", "degrees"]
+            seen.append(out)
+        assert len(seen) >= 2
+        with pytest.raises(ValueError, match="unknown query"):
+            res.snapshot("nope")
+        counters = bus.snapshot()["counters"]
+        assert counters["multiquery.runs"] == 1
+        assert counters["multiquery.emissions"] == 2 * len(seen)
+        assert counters["multiquery.snapshot_reads"] >= 2 * len(seen)
+        assert bus.gauges["multiquery.fused_queries"] == 2
+
+
+def test_fold_spans_carry_per_query_attribution(tmp_path):
+    from gelly_tpu import obs
+
+    queries = [cc_query(N_V), degrees_query(N_V)]
+    tracer = obs.SpanTracer()
+    with obs.scope() as bus, obs.install(tracer):
+        windows = len(list(run_aggregation(
+            None, _stream(), queries=queries, merge_every=2, **_kw()
+        )))
+    folds = tracer.spans("fold")
+    assert folds and all(
+        s["args"]["queries"] == "cc,degrees" for s in folds
+    )
+    # one per-query track span per window close
+    mq = tracer.spans("multiquery")
+    per_query = {}
+    for s in mq:
+        per_query.setdefault(s["args"]["query"], []).append(s)
+    assert sorted(per_query) == ["cc", "degrees"]
+    assert all(len(v) == windows for v in per_query.values())
+    path = str(tmp_path / "trace.json")
+    trace = obs.write_chrome_trace(path, tracer, bus=bus)
+    from gelly_tpu.obs.export import validate_chrome_trace
+
+    validate_chrome_trace(trace)
+
+
+# --------------------------------------------------------------------- #
+# multi-tenant integration: N tenants x Q queries, one dispatch
+
+
+def test_multiquery_plan_as_tenant_tier():
+    """A MultiQueryPlan is a valid tenant-tier plan: N tenants x Q
+    queries advance in chunks-per-tenant dispatches, and every
+    tenant's per-query snapshot is bit-identical to its single-tenant
+    fused run."""
+    from gelly_tpu.engine.tenants import MultiTenantEngine
+
+    def tenant_edges(seed):
+        rng = np.random.default_rng(seed)
+        return [(int(a), int(b))
+                for a, b in rng.integers(0, N_V, (3 * CHUNK, 2))]
+
+    fused = fuse([cc_query(N_V), degrees_query(N_V)])
+    eng = MultiTenantEngine(merge_every=2)
+    eng.add_tier("mq", fused, CHUNK)
+    n = 4
+    for i in range(n):
+        eng.admit(i, "mq", chunks=_stream(tenant_edges(i)))
+    out = eng.drain()
+    assert eng.stats["dispatches"] == 3  # chunks per tenant, not n x 3
+    for i in range(n):
+        oracle = run_aggregation(
+            None, _stream(tenant_edges(i)),
+            queries=[cc_query(N_V), degrees_query(N_V)],
+            merge_every=2, **_kw()
+        ).result()
+        assert sorted(out[i]) == ["cc", "degrees"]
+        for name in ("cc", "degrees"):
+            _assert_tree_identical(oracle[name], out[i][name],
+                                   f"tenant{i}/{name}")

@@ -728,3 +728,196 @@ def test_tenant_router_unroutable_payloads_counted():
             eng.stop()
             s.stop()
             router.stop()
+
+
+# --------------------------------------------------------------------- #
+# idle-lane reclamation (lane widths previously only grew)
+
+
+def _feed_blocking(eng, tid, it, n):
+    """Submit up to n chunks, blocking on a small queue bound so chunks
+    are never dropped on the floor."""
+    fed = 0
+    deadline = time.time() + 60
+    while fed < n and time.time() < deadline:
+        c = next(it, None)
+        if c is None:
+            break
+        while eng.queue_depth(tid) >= 2 and time.time() < deadline:
+            time.sleep(0.002)
+        eng.submit(tid, c)
+        fed += 1
+    return fed
+
+
+def test_idle_lane_reclamation_halves_width(tmp_path):
+    """High-water live count below width/2 for K consecutive windows
+    halves the tier stack: evicted (done) tenants' rows are snapshotted
+    (queries keep answering, final checkpoint durable) and live tenants
+    compact into the low lanes; later admissions reuse them."""
+    cc = _cc_plan()
+    with obs_bus.scope() as bus:
+        eng = MultiTenantEngine(merge_every=1, reclaim_after=2,
+                                checkpoint_dir=str(tmp_path))
+        eng.add_tier("cc", cc, CHUNK)
+        for i in range(6):  # short streams: finish after 3 windows
+            eng.admit(i, "cc", chunks=_stream(i))
+        eng.admit("a", "cc")
+        eng.admit("b", "cc")
+        tier = eng._tiers["cc"]
+        assert tier.batch.lanes == 8
+        eng.start()
+        try:
+            chunks = {t: list(_stream(900 + ord(t), n_edges=960))
+                      for t in ("a", "b")}
+            feeds = {t: iter(cs) for t, cs in chunks.items()}
+            fed = {"a": 0, "b": 0}
+            # Phase 1: 2 live tenants of 8 lanes — high-water 2 < 8/2,
+            # so the stack halves to 4 (and stops there: 2*2 < 4 is
+            # false, the hysteresis bound).
+            deadline = time.time() + 90
+            while time.time() < deadline and tier.batch.lanes > 4:
+                for t, it in feeds.items():
+                    fed[t] += _feed_blocking(eng, t, it, 1)
+            assert tier.batch.lanes == 4
+            # Phase 2: finish one live tenant; high-water drops to 1 <
+            # 4/2 and the stack halves again to the 2-lane pow-2 floor.
+            eng.finish("b")
+            while time.time() < deadline and tier.batch.lanes > 2:
+                fed["a"] += _feed_blocking(eng, "a", feeds["a"], 1)
+            assert tier.batch.lanes == 2
+            assert eng.stats["reclaims"] >= 2
+            assert eng.stats["lanes_reclaimed"] >= 6
+            assert bus.counters["tenants.reclaims"] >= 2
+            assert bus.counters["tenants.lanes_reclaimed"] >= 6
+
+            # Evicted tenants: queries answer from the parked row,
+            # bit-identical to the standalone oracle, with a durable
+            # final checkpoint at the evicted position.
+            for i in range(6):
+                got = eng.labels(i)
+                assert got is not None
+                want = np.asarray(
+                    _stream(i).aggregate(cc, merge_every=1).result()
+                )
+                assert got.tobytes() == want.tobytes()
+                assert eng.snapshot_window(i) > 0
+                pos = eng.position(i)
+                assert os.path.exists(
+                    eng._tenants[i].manager.path_for(pos)
+                )
+
+            # A post-reclaim admission reuses the freed lane space.
+            lane_c = eng.admit("c", "cc", chunks=_stream(777))
+            assert lane_c <= 2
+            eng.finish("a")
+            deadline = time.time() + 60
+            while time.time() < deadline and any(
+                not t.done for t in eng._tenants.values()
+            ):
+                time.sleep(0.01)
+        finally:
+            eng.stop()
+        # Live tenants were remapped mid-serving and the new admission
+        # rode the shrunken stack: all still bit-identical to oracles
+        # over exactly the chunks they folded.
+        from gelly_tpu.engine.aggregation import run_aggregation
+
+        for tid in ("a", "b"):
+            assert eng.position(tid) == fed[tid]
+            want = np.asarray(run_aggregation(
+                cc, chunks[tid][: fed[tid]], merge_every=1,
+                ingest_workers=0, prefetch_depth=0, h2d_depth=0,
+            ).result())
+            got = eng.labels(tid)
+            assert got is not None
+            assert got.tobytes() == want.tobytes(), tid
+        want_c = np.asarray(
+            _stream(777).aggregate(cc, merge_every=1).result()
+        )
+        got_c = eng.labels("c")
+        assert got_c is not None and got_c.tobytes() == want_c.tobytes()
+
+
+def test_reclamation_respects_min_lanes_and_stays_off_by_default():
+    """min_lanes floors the shrink target, and an engine without
+    reclaim_after never reclaims no matter how idle the tier goes."""
+    cc = _cc_plan()
+    # Default: off. Drain a tier down to one live tenant; width stays.
+    eng = MultiTenantEngine(merge_every=1)
+    eng.add_tier("cc", cc, CHUNK)
+    for i in range(4):
+        eng.admit(i, "cc", chunks=_stream(i))
+    eng.drain()
+    assert eng._tiers["cc"].batch.lanes == 4
+    assert eng.stats["reclaims"] == 0
+
+    # min_lanes=4 floors the target: 1 live tenant of 4 lanes never
+    # shrinks below the floor (and so never reclaims at all here).
+    eng2 = MultiTenantEngine(merge_every=1, reclaim_after=1)
+    eng2.add_tier("cc", _cc_plan(), CHUNK, min_lanes=4)
+    for i in range(3):
+        eng2.admit(i, "cc", chunks=_stream(i))
+    eng2.admit("live", "cc")
+    eng2.start()
+    try:
+        it = iter(list(_stream(321, n_edges=320)))
+        _feed_blocking(eng2, "live", it, 10)
+        time.sleep(0.3)  # several windows' worth of close cadence
+        assert eng2._tiers["cc"].batch.lanes == 4
+        assert eng2.stats["reclaims"] == 0
+        eng2.finish("live")
+        deadline = time.time() + 30
+        while time.time() < deadline and any(
+            not t.done for t in eng2._tenants.values()
+        ):
+            time.sleep(0.01)
+    finally:
+        eng2.stop()
+
+
+def test_reclaim_after_validation():
+    with pytest.raises(ValueError, match="reclaim_after"):
+        MultiTenantEngine(reclaim_after=0)
+
+
+def test_reclamation_defers_while_a_tenant_is_half_admitted():
+    """admit() publishes (lane, resume state, readiness) in stages; a
+    reclaim interleaving with it would remap or drop the lane the
+    admission still holds. The reclaim body therefore DEFERS whenever
+    any lane-holding tenant is not yet ready — and proceeds once the
+    admission completes."""
+    cc = _cc_plan()
+    with obs_bus.scope():
+        eng = MultiTenantEngine(merge_every=1, reclaim_after=1)
+        eng.add_tier("cc", cc, CHUNK)
+        for i in range(4):
+            eng.admit(i, "cc", chunks=_stream(i))
+        eng.admit("live", "cc")
+        for c in _stream(55, n_edges=64):
+            eng.submit("live", c)
+        eng.finish("live")
+        eng.drain()  # everyone done; width 8 (5 admits)
+        tier = eng._tiers["cc"]
+        width0 = tier.batch.lanes
+        # Simulate an in-flight admission: insert a lane-holding tenant
+        # that admit() has not yet marked ready, then force the reclaim
+        # conditions — the body must refuse to shrink.
+        from gelly_tpu.engine.tenants import _Tenant
+
+        half = _Tenant("half", "cc", width0 - 1)
+        with eng._lock:
+            eng._tenants["half"] = half
+        tier.low_windows = 10
+        tier.hw_active = 0
+        eng._maybe_reclaim(tier, obs_bus.get_bus(), None)
+        assert tier.batch.lanes == width0
+        assert eng.stats["reclaims"] == 0
+        # Admission completes: the same conditions now reclaim.
+        with eng._lock:
+            half.ready = True
+            half.done = True  # finished instantly; lane is evictable
+        tier.low_windows = 10
+        eng._maybe_reclaim(tier, obs_bus.get_bus(), None)
+        assert tier.batch.lanes < width0
+        assert eng.stats["reclaims"] == 1
