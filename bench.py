@@ -40,6 +40,22 @@ def emit(obj: dict) -> dict:
     return obj
 
 
+def trace_out_path(stem: str) -> str:
+    """Path for a workload's Chrome-trace capture next to bench.py.
+
+    Default runs write ``<stem>.scratch.json`` (gitignored) so
+    ``--workload`` invocations never dirty the tree; a RECORDED round
+    (``GELLY_BENCH_RECORD=1``) writes the canonical committed name
+    ``<stem>.json`` the artifacts/README cite.
+    """
+    import os
+
+    name = (f"{stem}.json"
+            if os.environ.get("GELLY_BENCH_RECORD") == "1"
+            else f"{stem}.scratch.json")
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+
+
 def write_bench_artifact(workload: str, path: str | None = None) -> None:
     """Write the run's collected line set next to bench.py.
 
@@ -1134,8 +1150,7 @@ def obs_trace_block(src, dst, n_v: int, chunk: int, merge_every: int,
         if t < dt_on:
             dt_on, best, bus_snap = t, tr, snap
     on_eps = n_e / dt_on
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        f"trace_{workload}.json")
+    path = trace_out_path(f"trace_{workload}")
     trace = obs.write_chrome_trace(  # validates the schema before writing
         path, best, extra={"workload": workload, **bus_snap},
     )
@@ -2470,8 +2485,7 @@ def bench_ingest(args) -> dict:
         )
     out["sharded_readers"] = sweep
     if best_trace is not None:
-        tpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "trace_ingest_sharded.json")
+        tpath = trace_out_path("trace_ingest_sharded")
         trace = obs.write_chrome_trace(
             tpath, best_trace, extra={"workload": "ingest_sharded_s4"},
         )
@@ -2542,6 +2556,132 @@ def bench_ingest(args) -> dict:
         "max_staged_depth": int(max_depth),
         "bounded": bool(max_depth <= hw),
     }
+
+    # ----------------------- pre-compressed wire (DATA_COMPRESSED)
+    # The shared compression plane's wire leg: the CLIENT compresses
+    # each chunk to its sparse CC pairs and ships DATA_COMPRESSED
+    # frames; the server admits them straight into staging and the
+    # engine folds the payloads with precompressed=True — a traced run
+    # shows ZERO server-side compress spans. Shape pinned to the
+    # codec's wire-win regime (edges >> touched vertices per chunk:
+    # 2^17-edge chunks over 2^12 slots => <= 4096 pairs * 8 B =
+    # ~0.25 B/edge), vs the 16 B/edge raw-edge DATA twin. eps rows are
+    # structural on a 1-core host like everything else here.
+    from gelly_tpu.core.chunk import make_chunk
+    from gelly_tpu.engine.aggregation import run_aggregation
+    from gelly_tpu.ingest.client import edge_payload
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    m1 = mesh_lib.make_mesh(1)
+    cw_nv = 1 << 12
+    cw_chunk = 1 << 17
+    cw_n = 8
+    cw_edges = cw_chunk * cw_n
+    rng = np.random.default_rng(17)
+    cagg = connected_components(cw_nv, codec="sparse")
+    cchunks = []
+    for _ in range(cw_n):
+        s = rng.integers(0, cw_nv, cw_chunk).astype(np.int64)
+        d = rng.integers(0, cw_nv, cw_chunk).astype(np.int64)
+        cchunks.append(make_chunk(
+            s.astype(np.int32), d.astype(np.int32),
+            raw_src=s, raw_dst=d, capacity=cw_chunk, device=False,
+        ))
+    t0 = time.perf_counter()
+    cpayloads = [cagg.host_compress(c) for c in cchunks]  # client leg
+    client_compress_s = time.perf_counter() - t0
+
+    def wire_pass(items, compressed):
+        with obs_bus.scope() as bus:
+            done = threading.Event()
+            with IngestServer(queue_depth=64) as srv:
+                def consume():
+                    for _ in srv.frames():
+                        pass
+                    done.set()
+
+                th = threading.Thread(target=consume, daemon=True)
+                th.start()
+                cli = IngestClient("127.0.0.1", srv.port,
+                                   send_pause_timeout=120)
+                cli.connect()
+                t0 = time.perf_counter()
+                for p in items:
+                    cli.send(p, compressed=compressed)
+                cli.flush(timeout=300)
+                wall = time.perf_counter() - t0
+                cli.close()
+            done.wait(timeout=30)
+            return wall, bus.snapshot()["counters"]
+
+    raw_wall, raw_snap = wire_pass(
+        [edge_payload(np.asarray(c.raw_src), np.asarray(c.raw_dst))
+         for c in cchunks], False,
+    )
+    comp_wall, comp_snap = wire_pass(cpayloads, True)
+
+    # Engine fold of the compressed stream (zero compress spans) +
+    # bit-identity vs the file-ingest codec path over the SAME chunks.
+    agg_wire = connected_components(cw_nv, codec="sparse")
+    tracer = obs.SpanTracer(capacity=1 << 16, heartbeat_every_s=None)
+    with obs.scope() as tb, obs.install(tracer):
+        with IngestServer(queue_depth=64, stop_on_bye=True) as srv:
+            def feed():
+                cli = IngestClient("127.0.0.1", srv.port,
+                                   send_pause_timeout=120)
+                cli.connect()
+                for p in cpayloads:
+                    cli.send_compressed(p)
+                cli.flush(timeout=300)
+                cli.close()
+
+            ft = threading.Thread(target=feed, daemon=True)
+            ft.start()
+            t0 = time.perf_counter()
+            wire_final = np.asarray(run_aggregation(
+                agg_wire, srv.compressed_payloads(), merge_every=cw_n,
+                mesh=m1, precompressed=True, ingest_workers=0,
+                prefetch_depth=0, h2d_depth=0,
+            ).result())
+            fold_wall = time.perf_counter() - t0
+            ft.join(timeout=60)
+        tsnap = tb.snapshot()
+    tpath = trace_out_path("trace_ingest_compressed")
+    trace = obs.write_chrome_trace(
+        tpath, tracer, extra={"workload": "ingest_compressed", **tsnap},
+    )
+    golden = np.asarray(run_aggregation(
+        cagg, cchunks, merge_every=cw_n, mesh=m1, ingest_workers=0,
+        prefetch_depth=0, h2d_depth=0,
+    ).result())
+    comp_bpe = comp_snap.get("ingest.bytes_received", 0) / cw_edges
+    raw_bpe = raw_snap.get("ingest.bytes_received", 0) / cw_edges
+    n_compress = len(tracer.spans("compress"))
+    out["compressed_wire"] = {
+        "vertices": cw_nv,
+        "chunk_size": cw_chunk,
+        "edges": cw_edges,
+        "client_compress_s": round(client_compress_s, 4),
+        "wire_bytes_per_edge": round(comp_bpe, 4),
+        "raw_wire_bytes_per_edge": round(raw_bpe, 4),
+        "wire_compression_x": round(raw_bpe / max(comp_bpe, 1e-9), 1),
+        "eps_wire_compressed": round(cw_edges / max(comp_wall, 1e-9), 1),
+        "eps_wire_raw": round(cw_edges / max(raw_wall, 1e-9), 1),
+        "eps_fold": round(cw_edges / max(fold_wall, 1e-9), 1),
+        "data_frames_compressed": int(
+            comp_snap.get("ingest.data_frames_compressed", 0)
+        ),
+        "server_compress_spans": n_compress,
+        "server_stack_spans": len(tracer.spans("stack")),
+        "zero_server_compress": bool(n_compress == 0),
+        "parity_vs_file_ingest": bool(
+            wire_final.tobytes() == golden.tobytes()
+        ),
+        "wire_bytes_per_edge_le_0p35": bool(comp_bpe <= 0.35),
+        "trace_file": os.path.basename(tpath),
+        "trace_events": len(trace["traceEvents"]),
+    }
+
     out["value"] = out["socket_ingest"]["eps"]
     return out
 
@@ -2622,10 +2762,7 @@ def bench_tenants(args) -> dict:
         batched_s = time.perf_counter() - t0
         if tracer is not None:
             folds = tracer.spans("fold")
-            tpath = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "trace_tenants_n64.json",
-            )
+            tpath = trace_out_path("trace_tenants_n64")
             obs.write_chrome_trace(
                 tpath, tracer, extra={"workload": "tenants_n64"},
             )
@@ -2799,10 +2936,7 @@ def bench_multiquery(args) -> dict:
             for s in ("produce", "compress", "h2d", "fold")
         }
         if qn == 4:
-            tpath = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "trace_multiquery_q4.json",
-            )
+            tpath = trace_out_path("trace_multiquery_q4")
             trace = obs.write_chrome_trace(
                 tpath, tracer,
                 extra={"workload": "multiquery_q4", **tsnap},
@@ -2860,6 +2994,68 @@ def bench_multiquery(args) -> dict:
             "parity": parity,
         }
 
+    # Fused codec sharing (the shared compression plane): the Q=2 set
+    # with every query's codec ON — ONE multi-query compressed payload
+    # per chunk, folds through fold_compressed. Structural bits:
+    # compress spans == chunks (not chunks x Q), fold dispatches stay
+    # 1/chunk, multiquery.compressed_chunks counts each chunk once,
+    # and every query's final summary is bit-identical to the raw
+    # fused run's.
+    cqueries = [cc_query(n_v, compressed=True, codec="sparse"),
+                degrees_query(n_v, compressed=True, codec="sparse")]
+    fused_c = fuse(cqueries)
+    raw_twin = fuse([cc_query(n_v), degrees_query(n_v)])
+
+    def c_pass(plan):
+        return run_aggregation(
+            plan, stream(), merge_every=merge_every
+        ).result()
+
+    c_pass(fused_c)  # compile warmup
+    c_pass(raw_twin)
+    c_wall = float("inf")
+    for _ in range(3):
+        with obs.scope() as cb:
+            t0 = time.perf_counter()
+            c_final = c_pass(fused_c)
+            c_wall = min(c_wall, time.perf_counter() - t0)
+            c_counters = cb.snapshot()["counters"]
+    raw_final = c_pass(raw_twin)
+    tracer = obs.SpanTracer(capacity=1 << 16)
+    with obs.scope(), obs.install(tracer):
+        c_pass(fused_c)
+    c_compress = tracer.spans("compress")
+    payload_bytes = sum(
+        s["args"].get("payload_bytes", 0) for s in c_compress
+    )
+    parity_c = {
+        q.name: bool(all(
+            np.asarray(w).tobytes() == np.asarray(g).tobytes()
+            for w, g in zip(jax.tree.leaves(c_final[q.name]),
+                            jax.tree.leaves(raw_final[q.name]))
+        ))
+        for q in cqueries
+    }
+    compressed_row = {
+        "queries": [q.name for q in cqueries],
+        "wall_s": round(c_wall, 4),
+        "raw_fused_wall_s": rows["2"]["wall_s"],
+        "compressed_chunks": int(
+            c_counters.get("multiquery.compressed_chunks", 0)
+        ),
+        "one_payload_per_chunk": bool(
+            c_counters.get("multiquery.compressed_chunks", 0) == chunks
+            and len(c_compress) == chunks
+        ),
+        "one_fold_dispatch_per_chunk": bool(
+            c_counters.get("engine.units_folded", 0) == chunks
+        ),
+        "compressed_payload_bytes_per_edge": round(
+            payload_bytes / n_edges, 4
+        ),
+        "parity_vs_raw_fused": parity_c,
+    }
+
     marginal = (walls[4] - walls[1]) / (3 * max(walls[1], 1e-9))
     q1s, q4s = rows["1"]["stage_spans"], rows["4"]["stage_spans"]
     shared_legs_equal = all(
@@ -2883,6 +3079,8 @@ def bench_multiquery(args) -> dict:
         "parity_ok": bool(all(
             all(r["parity"].values()) for r in rows.values()
         )),
+        "compressed": compressed_row,
+        "fused_codec_parity": bool(all(parity_c.values())),
         **trace_info,
         "available_cores": cores,
         "scaling_measurable": bool(cores >= 2 and marginal <= 0.10),

@@ -4,9 +4,16 @@ Usage: python examples/connected_components.py [--checkpoint-dir=DIR]
            [--codec-workers=K] [--h2d-depth=D] [--merge-mode=MODE]
            [--trace-out=PATH] [--shards=S]
            [--queries=cc,degrees,bipartiteness]
-           [--serve=PORT | --connect=HOST:PORT]
+           [--serve=PORT | --connect=HOST:PORT] [--compressed]
            [<edges path> <merge every chunks>]
 Prints (vertex, component) pairs after each merge window.
+
+``--compressed`` (with ``--serve``/``--connect``) switches the wire to
+client-side-compressed DATA_COMPRESSED frames: the connect peer runs
+each chunk through the CC sparse codec before send (~0.25 B/edge at
+scale instead of 16 B/edge raw pairs) and the serve peer folds the
+payloads directly — zero server-side compress spans (README
+"Ingestion", shared compression plane). Both sides must pass it.
 
 ``--queries=cc,degrees,bipartiteness`` fuses several questions over the
 ONE stream (README "Fused multi-query"): each chunk is staged and
@@ -80,8 +87,23 @@ def _serve_stream(port, vertex_capacity=1 << 16, chunk_capacity=4096):
     return EdgeStream(chunks, ctx), server
 
 
-def _connect_main(target, rest):
-    """Stream the edge file (or the default data) to a --serve peer."""
+_WIRE_CAPACITY = 1 << 16
+_WIRE_CHUNK = 4096
+
+
+def _wire_codec_plan():
+    # The shared client/server codec of the --compressed wire: both
+    # sides must agree on the payload format (sparse (v, root) pairs)
+    # for the server to fold the client's bytes directly.
+    return connected_components(_WIRE_CAPACITY, codec="sparse")
+
+
+def _connect_main(target, rest, compressed=False):
+    """Stream the edge file (or the default data) to a --serve peer.
+    With ``--compressed``, each chunk is reduced CLIENT-SIDE to its
+    sparse spanning-forest pairs (the plan's ingest codec) and shipped
+    as a DATA_COMPRESSED frame — the server folds the payload directly,
+    paying zero compress time (README "Ingestion")."""
     import numpy as np
 
     from gelly_tpu.ingest import IngestClient
@@ -96,11 +118,87 @@ def _connect_main(target, rest):
         src = np.asarray([e[0] for e in edges], dtype=np.int64)
         dst = np.asarray([e[1] for e in edges], dtype=np.int64)
     cli = IngestClient(host, int(port)).connect()
-    frames = cli.send_edges(src, dst)
+    if compressed:
+        from gelly_tpu.core.chunk import make_chunk
+
+        agg = _wire_codec_plan()
+        frames = 0
+        for lo in range(0, src.shape[0], _WIRE_CHUNK):
+            s, d = src[lo:lo + _WIRE_CHUNK], dst[lo:lo + _WIRE_CHUNK]
+            c = make_chunk(
+                s.astype(np.int32), d.astype(np.int32),
+                raw_src=s, raw_dst=d, capacity=_WIRE_CHUNK,
+                device=False,
+            )
+            cli.send_compressed(agg.host_compress(c))
+            frames += 1
+        kind = "client-compressed"
+    else:
+        frames = cli.send_edges(src, dst, chunk_size=_WIRE_CHUNK)
+        kind = "raw-edge"
     cli.flush(timeout=60)
     cli.close()  # BYE ends the server's stream
     print(f"# streamed {src.shape[0]} edges in {frames} CRC-checked "
-          f"frames; server acked {cli.acked}")
+          f"{kind} frames; server acked {cli.acked}")
+
+
+def _serve_compressed_main(port, merge_every, trace_out,
+                           codec_workers=None, h2d_depth=None,
+                           merge_mode="auto"):
+    """--serve --compressed: fold CLIENT-compressed payloads straight
+    off the wire (``run_aggregation(precompressed=True)``) — a traced
+    run shows zero ``compress`` spans on this side. The executor knobs
+    (--codec-workers/--h2d-depth/--merge-mode) configure this
+    aggregate path exactly like the file-ingest run's."""
+    from gelly_tpu import IdentityVertexTable, StreamContext
+    from gelly_tpu.engine.aggregation import run_aggregation
+    from gelly_tpu.ingest import IngestServer
+    from gelly_tpu.library.connected_components import (
+        connected_components,
+    )
+
+    server = IngestServer(port=port, stop_on_bye=True).start()
+    print(f"# compressed ingest server on port {server.port}; waiting "
+          "for a --connect ... --compressed peer (the client compresses; "
+          "this side folds the payloads directly)")
+    ctx = StreamContext(table=IdentityVertexTable(_WIRE_CAPACITY),
+                        vertex_capacity=_WIRE_CAPACITY)
+    agg = connected_components(_WIRE_CAPACITY, codec="sparse",
+                               merge_mode=merge_mode)
+
+    def run():
+        labels = None
+        res = run_aggregation(
+            agg, server.compressed_payloads(),
+            merge_every=merge_every, precompressed=True,
+            codec_workers=codec_workers, h2d_depth=h2d_depth,
+        )
+        try:
+            for labels in res:
+                pass  # continuously-improving; print the final
+        finally:
+            server.stop()
+        return labels
+
+    if trace_out is None:
+        labels = run()
+    else:
+        from gelly_tpu import obs
+
+        tracer = obs.SpanTracer()
+        with obs.scope() as bus, obs.install(tracer):
+            labels = run()
+        trace = obs.write_chrome_trace(trace_out, tracer, bus=bus)
+        n_compress = len(tracer.spans("compress"))
+        print(f"# trace: {len(trace['traceEvents'])} events -> "
+              f"{trace_out} (server-side compress spans: {n_compress}; "
+              f"trace_id={tracer.trace_id})")
+    if labels is None:
+        print("# stream ended before any payload arrived; nothing to "
+              "fold")
+        return
+    for comp in labels_to_components(labels, ctx):
+        print(f"{comp[0]}: {comp}")
 
 
 def _multiquery_main(stream, names, merge_every, shards, trace_out):
@@ -173,6 +271,7 @@ def main(args):
     serve = None
     connect = None
     queries = None
+    compressed = False
     rest = []
     for a in args:
         if a.startswith("--checkpoint-dir="):
@@ -193,6 +292,8 @@ def main(args):
             serve = int(a.split("=", 1)[1])
         elif a.startswith("--connect="):
             connect = a.split("=", 1)[1]
+        elif a == "--compressed":
+            compressed = True
         else:
             rest.append(a)
     if ckpt_dir is not None and (
@@ -208,8 +309,14 @@ def main(args):
         )
     if sum(x is not None for x in (serve, connect)) > 1:
         raise SystemExit("--serve and --connect are mutually exclusive")
+    if compressed and serve is None and connect is None:
+        raise SystemExit(
+            "--compressed shapes the WIRE (client-side codec payloads "
+            "in DATA_COMPRESSED frames); pair it with --serve or "
+            "--connect"
+        )
     if connect is not None:
-        return _connect_main(connect, rest)
+        return _connect_main(connect, rest, compressed=compressed)
     if serve is not None and (ckpt_dir is not None or shards is not None):
         raise SystemExit(
             "--serve ingests from the wire — it cannot also read a "
@@ -220,6 +327,17 @@ def main(args):
             "--shards uses the pipelined executor's sharded source "
             "provider; drop --checkpoint-dir (use aggregate-path "
             "checkpoint_path resume instead)"
+        )
+    if serve is not None and compressed:
+        if queries is not None:
+            raise SystemExit(
+                "--serve --compressed folds the wire codec's single CC "
+                "plan; --queries is the fused raw-chunk path — drop one"
+            )
+        return _serve_compressed_main(
+            serve, arg(rest, 1, 4), trace_out,
+            codec_workers=codec_workers, h2d_depth=h2d_depth,
+            merge_mode=merge_mode,
         )
     if serve is not None:
         stream, server = _serve_stream(serve)

@@ -108,6 +108,25 @@ class SummaryAggregation:
     # shard count (the batch axis splits across devices); 1 on a single
     # shard. None = leaves are equal-shape and np.stack-ed generically.
     stack_payloads: Callable[..., Any] | None = None
+    # Optional host-side validator for PRODUCER-COMPRESSED payloads
+    # (wire DATA_COMPRESSED frames, tenant submit_payload, the engine's
+    # precompressed=True staging): ``codec_payload_check(payload)``
+    # raises ValueError on a payload the device fold could only
+    # mis-index SILENTLY — out-of-range ids scatter-drop/clamp on
+    # device, the exact corruption mode payload_to_chunk's
+    # vertex_capacity guard exists to prevent on the raw wire. Checked
+    # at the staging/enqueue boundary so the error lands on the
+    # producer side, never the scheduler/fold thread.
+    codec_payload_check: Callable[[Any], None] | None = None
+    # Wire/stacking pad values for the codec payload's VARIABLE-LENGTH
+    # dict keys (e.g. the sparse CC pairs' {"v": -1, "r": 0}): consumers
+    # that stack per-chunk payloads themselves — the tenant engine's
+    # compressed tiers, which stack one payload per LANE instead of K
+    # per unit — pad each key to a shared bucket with these values so
+    # the padded lanes fold as no-ops exactly like the plan's own
+    # stack_payloads padding. None with a dict payload means every key
+    # is fixed-shape (stacked as-is); ndarray payloads never need it.
+    codec_pad_values: dict | None = None
     # True when stack_payloads mutates per-run state in STREAM order (the
     # compact plans' persistent id assignment): the engine then numbers
     # codec units from 0 per run and passes ``seq=`` to stack_payloads so
@@ -321,6 +340,43 @@ def bucket_stack_payloads(payloads: list, pad_values: dict,
         else:
             out[key] = np.stack([p[key] for p in payloads])
     return out
+
+
+def sparse_payload_id_check(vertex_capacity: int, *keys: str):
+    """Build a ``codec_payload_check`` (see the SummaryAggregation
+    field) validating that every listed key of a sparse codec payload
+    carries vertex ids in ``[0, vertex_capacity)`` — the
+    ``payload_to_chunk`` range guard's twin for pre-compressed ingest,
+    where the payload never passes through a chunk. O(k) numpy min/max
+    per key, run on the producer/staging side."""
+    def check(payload) -> None:
+        if not isinstance(payload, dict):
+            raise ValueError(
+                f"compressed payload must be a dict of arrays, got "
+                f"{type(payload).__name__} — was it compressed by a "
+                "different plan/codec?"
+            )
+        for key in keys:
+            if key not in payload:
+                raise ValueError(
+                    f"compressed payload is missing key {key!r} — was "
+                    "it compressed by a different plan/codec?"
+                )
+            a = np.asarray(payload[key])
+            if a.size == 0:
+                continue
+            lo, hi = int(a.min()), int(a.max())
+            if lo < 0 or hi >= vertex_capacity:
+                bad = lo if lo < 0 else hi
+                raise ValueError(
+                    f"compressed payload key {key!r} carries vertex id "
+                    f"{bad} out of range for vertex_capacity "
+                    f"{vertex_capacity} — compressed by a plan with a "
+                    "different capacity? (an out-of-range id would "
+                    "silently drop/clamp in the device scatter)"
+                )
+
+    return check
 
 
 def _payload_nbytes(payload) -> int:
@@ -659,6 +715,10 @@ class TenantPlan(NamedTuple):
     snapshot: Callable[[Summary], Any]  # query-safe copy (never aliases)
     flatten: Callable[[Summary], Summary] | None  # vmapped path flatten
     lanes: int
+    # Vmapped compressed fold for codec tiers: (stacked, stacked_payload,
+    # active) -> stacked, each lane folding its own pre-compressed
+    # [1, ...]-batched payload (None for plans without fold_compressed).
+    fold_codec: Callable[..., Summary] | None = None
 
 
 def _compiled_tenant_plan(agg: SummaryAggregation, lanes: int,
@@ -681,21 +741,30 @@ def _compiled_tenant_plan(agg: SummaryAggregation, lanes: int,
     data-parallel with no cross-lane collectives, so XLA partitions the
     vmapped program for free.
 
-    Plans that fold only through a stateful host codec
-    (``requires_codec`` / ``stack_ordered``) are refused loudly: their
-    id-assignment sessions are per-run host state the stacked batch
-    cannot share. Host-side transforms (``jit_transform=False``) are
-    refused too — queries read device snapshots.
+    Plans whose codec is a STATEFUL ordered stacker (``stack_ordered``)
+    are refused loudly: their id-assignment session consumes payloads in
+    global stream order, which concurrent tenant lanes cannot provide.
+    Plain codec plans (``host_compress``/``fold_compressed``, incl.
+    ``requires_codec``) compile a vmapped ``fold_codec`` next to the raw
+    fold — the compressed-tier dispatch path. Host-side transforms
+    (``jit_transform=False``) are refused too — queries read device
+    snapshots.
     """
     if lanes < 1:
         raise ValueError(f"lanes must be >= 1, got {lanes}")
-    if agg.requires_codec or agg.stack_ordered:
+    if agg.stack_ordered:
         raise ValueError(
-            f"aggregation '{agg.name}' folds through a stateful host codec "
-            "(requires_codec/stack_ordered); the tenant batch folds raw "
-            "chunks — build the tier plan without the ordered codec (e.g. "
-            "connected_components(..., ingest_combine=False) or "
-            "codec='sparse')"
+            f"aggregation '{agg.name}' uses an ordered stacker "
+            "(stack_ordered: its codec session assigns compact ids in "
+            "GLOBAL STREAM order — per-run host state no concurrent "
+            "tenant lane order can reproduce); build the tier plan on a "
+            "stateless codec (e.g. codec='sparse') or the raw fold "
+            "(ingest_combine=False)"
+        )
+    if agg.requires_codec and agg.fold_compressed is None:
+        raise ValueError(
+            f"aggregation '{agg.name}' sets requires_codec but supplies "
+            "no fold_compressed — the tier has no fold to compile"
         )
     if agg.transform is not None and not agg.jit_transform:
         raise ValueError(
@@ -743,6 +812,22 @@ def _compiled_tenant_plan(agg: SummaryAggregation, lanes: int,
     # call and snapshots only through `snapshot`, which never aliases).
     batch_fold = jax.jit(jax.vmap(_lane_fold), donate_argnums=0, **jit_kw)
 
+    batch_fold_codec = None
+    if agg.fold_compressed is not None:
+        def _lane_fold_codec(s, payload, active):
+            # Each lane folds its own [1, ...]-batched compressed payload
+            # (the engine's stacked-unit contract at K=1, so the very
+            # same fold_compressed serves both paths); inactive lanes
+            # select back bit-unchanged like the raw masked lane.
+            s2 = agg.fold_compressed(s, payload)
+            return jax.tree.map(
+                lambda new, old: jnp.where(active, new, old), s2, s
+            )
+
+        batch_fold_codec = jax.jit(
+            jax.vmap(_lane_fold_codec), donate_argnums=0, **jit_kw
+        )
+
     batch_merger = jax.jit(jax.vmap(agg.combine), **jit_kw)
 
     batch_transform = (
@@ -767,7 +852,7 @@ def _compiled_tenant_plan(agg: SummaryAggregation, lanes: int,
     plan = TenantPlan(
         init=batch_init, fold=batch_fold, merger=batch_merger,
         transform=batch_transform, snapshot=snapshot_fn,
-        flatten=batch_flatten, lanes=lanes,
+        flatten=batch_flatten, lanes=lanes, fold_codec=batch_fold_codec,
     )
     per_agg[key] = plan
     return plan
@@ -793,6 +878,7 @@ def run_aggregation(
     timer=None,
     source_provider=None,
     queries=None,
+    precompressed: bool = False,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
 
@@ -895,6 +981,24 @@ def run_aggregation(
     dicts keyed by query name + live per-query ``snapshot`` reads with
     a one-window staleness bound). Merge-every mode only; see the
     multiquery module docs for fusion eligibility.
+
+    **Pre-compressed payload streams** (``precompressed=True``): the
+    stream yields per-chunk COMPRESSED payloads (the plan's
+    ``host_compress`` output — e.g. wire ``DATA_COMPRESSED`` frames a
+    client compressed before send) instead of chunks. The staging
+    workers then skip ``host_compress`` entirely: each unit is stacked
+    (``stack_payloads``) and transferred as received, so a traced run
+    shows ZERO ``compress`` spans — the per-unit staging work lands on
+    a ``stack`` span/timer stage instead. The shared-compression-plane
+    contract: a chunk is compressed once, at the producer, and every
+    downstream consumer folds the compressed payload directly.
+    Requires a plan whose codec can engage here (``host_compress`` +
+    ``fold_compressed``, and a batch the mesh can align — the same
+    rules as ``requires_codec``); merge_every mode only (payloads
+    carry no per-edge timestamps), and ``host_precombine`` /
+    ``source_provider`` are chunk-path knobs it refuses. The
+    last-retired-chunk checkpoint rule counts payload units exactly
+    like chunks, so exactly-once resume composes unchanged.
 
     **Exactly-once resume — the last-retired-chunk rule**: the recorded
     checkpoint position counts only chunks whose fold was *dispatched*
@@ -1069,6 +1173,45 @@ def run_aggregation(
             if batch % S:
                 use_codec = False  # no aligned batching possible
 
+    # The precompressed checks come FIRST: a stack_ordered plan must be
+    # named for its ordered session, not for a batch-alignment detail.
+    if precompressed:
+        if window_ms is not None:
+            raise ValueError(
+                "precompressed=True is merge_every-only: codec payloads "
+                "carry no per-edge timestamps to form event-time "
+                "windows from"
+            )
+        if host_precombine is not None:
+            raise ValueError(
+                "host_precombine rewrites raw chunks; a precompressed "
+                "stream carries codec payloads the producer already "
+                "reduced — drop one of the two"
+            )
+        if source_provider is not None:
+            raise ValueError(
+                "source_provider parses raw edge files; a precompressed "
+                "stream already carries codec payloads — drop one of "
+                "the two"
+            )
+        if agg.stack_ordered:
+            raise ValueError(
+                f"aggregation '{agg.name}' uses an ordered stacker "
+                "(stack_ordered): its codec session assigns compact "
+                "ids in global stream order on THIS side, and its "
+                "per-chunk host_compress ships raw edge views — a "
+                "producer cannot meaningfully pre-compress for it; "
+                "use a stateless codec (e.g. codec='sparse') on the "
+                "wire"
+            )
+        if not use_codec:
+            raise ValueError(
+                f"precompressed=True needs a codec-capable plan: "
+                f"'{agg.name}' must supply host_compress + "
+                "fold_compressed (and the payload batch must align "
+                f"with the {S}-shard mesh) so the pre-compressed "
+                "payloads have a fold to land in"
+            )
     if agg.requires_codec and not use_codec:
         raise ValueError(
             f"aggregation '{agg.name}' folds only through its ingest codec, "
@@ -1413,6 +1556,15 @@ def run_aggregation(
                 capacity=1, device=False,
             )
             identity_payload = agg.host_compress(empty)
+        # Precompressed streams skip host_compress entirely, so the
+        # per-unit staging work is attributed to a ``stack`` span/timer
+        # stage — a traced run proves structurally that the consumer
+        # paid ZERO compress time for bytes the producer shipped
+        # compressed.
+        stage_span = "stack" if precompressed else "compress"
+        stage_timer_name = (
+            "ingest_stack" if precompressed else "ingest_compress"
+        )
 
         def stage_unit(unit):
             # Pipeline stage 1 — HOST compress only (the K-worker pool):
@@ -1429,10 +1581,15 @@ def run_aggregation(
                 payload, k = _stage_unit_inner(seq, group)
                 edges = None
                 if tracer is not None:
-                    edges = _group_edges(group)
+                    # Payload items carry no valid mask: edge attribution
+                    # is a chunk-path extra the compressed wire forgoes.
+                    edges = (
+                        None if precompressed else _group_edges(group)
+                    )
                     tracer.span(
-                        "compress",
-                        f"compress/{threading.current_thread().name}",
+                        stage_span,
+                        f"{stage_span}/"
+                        f"{threading.current_thread().name}",
                         t0, unit=seq, chunks=k, edges=edges,
                         payload_bytes=_payload_nbytes(payload),
                         queue_depth=bus.gauges.get(
@@ -1451,8 +1608,22 @@ def run_aggregation(
         def _stage_unit_inner(seq, group):
             k = len(group)
             if use_codec:
-                with timer("ingest_compress"):
-                    payloads = [agg.host_compress(c) for c in group]
+                with timer(stage_timer_name):
+                    if precompressed:
+                        # Producer-compressed payloads ride as-is: the
+                        # stack/pad/mesh-split below is the ONLY staging
+                        # work left on this side — plus the plan's id
+                        # range check (payload_to_chunk parity: an
+                        # out-of-range id must raise HERE, not silently
+                        # drop/clamp in the device scatter).
+                        payloads = [
+                            jax.tree.map(np.asarray, p) for p in group
+                        ]
+                        if agg.codec_payload_check is not None:
+                            for p in payloads:
+                                agg.codec_payload_check(p)
+                    else:
+                        payloads = [agg.host_compress(c) for c in group]
                     if k < batch:
                         payloads += [identity_payload] * (batch - k)
                     if agg.stack_payloads is not None:

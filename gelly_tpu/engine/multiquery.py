@@ -45,9 +45,11 @@ compiled program, so the whole engine carries it unchanged:
 
 Fusion eligibility (refused loudly at :func:`fuse` time):
 
-- plans folding only through a stateful host codec
-  (``requires_codec`` / ``stack_ordered``) — their per-run id sessions
-  cannot ride a shared raw-chunk fold;
+- plans whose codec is a STATEFUL ordered stacker (``stack_ordered``:
+  the compact-id session consumes payloads in global stream order,
+  which the shared per-chunk compress stage cannot provide);
+- ``requires_codec`` plans in a set whose shared codec cannot engage
+  (see below) — their raw fold does not exist;
 - ``transient`` plans — their emit-and-reset window contract needs the
   engine's Merger path, which the fused accumulate plan bypasses;
 - host-side transforms (``jit_transform=False``) — fused emissions are
@@ -58,10 +60,21 @@ Fusion eligibility (refused loudly at :func:`fuse` time):
 - per-query ``every`` > 1 on an accumulating plan (no merge window to
   defer) and duplicate / reserved query names.
 
-Per-query codecs (``host_compress``) are deliberately NOT engaged: the
-fused pipeline stages each chunk once for every query, so the fused
-fold is the RAW fold composition — build sub-plans with
-``ingest_combine=False`` (the library ``*_query`` helpers do).
+**Fused codec sharing** (the shared compression plane): when EVERY
+query supplies a stateless ingest codec (``host_compress`` +
+``fold_compressed``) and accumulates, the fused plan grows its own
+shared compress stage — ONE multi-query compressed payload per chunk
+(``{query_name: per-query payload}``), staged and transferred H2D
+once, with every query's ``fold_compressed`` running inside the one
+fused dispatch. The ~0.25 B/edge codec wire win then covers fused
+runs too. Build the sub-queries with their codecs on (the library
+``*_query`` helpers' ``compressed=True``); mixed or non-accumulating
+sets fall back to the raw-chunk fused fold (per-query masked merge
+windows need the per-chunk raw fold — a K-stacked payload dispatch
+cannot interleave fold and merge at chunk grain). ``share_codec``
+forces the decision: ``True`` refuses sets the codec cannot cover,
+``False`` pins the raw path, ``"auto"`` (default) engages when
+eligible.
 """
 
 from __future__ import annotations
@@ -137,7 +150,8 @@ def _as_spec(q) -> QuerySpec:
     )
 
 
-def fuse(queries, *, name: str | None = None) -> MultiQueryPlan:
+def fuse(queries, *, name: str | None = None,
+         share_codec="auto") -> MultiQueryPlan:
     """Stack Q heterogeneous aggregations into one fused plan.
 
     ``queries`` — iterable of :class:`QuerySpec` /
@@ -147,7 +161,21 @@ def fuse(queries, *, name: str | None = None) -> MultiQueryPlan:
     ``run_aggregation(queries=...)`` (which wraps the emission stream
     in a :class:`MultiQueryStream`) or hand it to
     ``MultiTenantEngine.add_tier`` as a tier plan.
+
+    ``share_codec`` — the fused-codec knob (module docs): ``"auto"``
+    engages the shared compress stage when every query is
+    codec-capable and accumulating, ``True`` REQUIRES it (ValueError
+    otherwise), ``False`` pins the raw-chunk fused fold.
     """
+    # Identity checks, not membership: 1 == True under `in`, but the
+    # strictness branches below test `is True` / `is False` — an int
+    # must not silently demote to "auto" semantics.
+    if not (share_codec is True or share_codec is False
+            or share_codec == "auto"):
+        raise ValueError(
+            f"share_codec must be 'auto', True or False, got "
+            f"{share_codec!r}"
+        )
     specs = [_as_spec(q) for q in queries]
     if not specs:
         raise ValueError("fuse needs at least one query")
@@ -172,13 +200,15 @@ def fuse(queries, *, name: str | None = None) -> MultiQueryPlan:
         if q.name in seen:
             raise ValueError(f"duplicate query name {q.name!r}")
         seen.add(q.name)
-        if q.agg.requires_codec or q.agg.stack_ordered:
+        if q.agg.stack_ordered:
             raise ValueError(
-                f"query {q.name!r} ({q.agg.name}) folds through a "
-                "stateful host codec (requires_codec/stack_ordered); "
-                "the fused plan folds the shared RAW chunk — build the "
-                "query without the ordered codec (e.g. "
-                "ingest_combine=False)"
+                f"query {q.name!r} ({q.agg.name}) uses an ordered "
+                "stacker (stack_ordered: its codec session assigns "
+                "compact ids in GLOBAL STREAM order); the fused "
+                "shared-compress stage compresses every query from the "
+                "same chunk with no cross-query ordering to offer — "
+                "build the query on a stateless codec (codec='sparse') "
+                "or the raw fold (ingest_combine=False)"
             )
         if q.agg.transient:
             raise ValueError(
@@ -214,6 +244,43 @@ def fuse(queries, *, name: str | None = None) -> MultiQueryPlan:
         )
     specs = tuple(specs)
     plan_name = name or "multiquery(" + "+".join(q.name for q in specs) + ")"
+
+    # Fused codec sharing: engages only when EVERY query supplies a
+    # stateless codec AND accumulates — a non-accumulating query's
+    # masked merge window fires at CHUNK grain inside the raw fold,
+    # which a K-stacked payload dispatch cannot interleave with.
+    codec_capable = [
+        q for q in specs
+        if q.agg.host_compress is not None
+        and q.agg.fold_compressed is not None
+    ]
+    codec_ok = len(codec_capable) == len(specs) and all(
+        q.accum for q in specs
+    )
+    use_codec = codec_ok and share_codec in ("auto", True)
+    if share_codec is True and not codec_ok:
+        raise ValueError(
+            "share_codec=True but the shared compress stage cannot "
+            "cover this set: every query must supply host_compress + "
+            "fold_compressed AND accumulate (codec-capable: "
+            f"{[q.name for q in codec_capable]} of "
+            f"{[q.name for q in specs]}; non-accumulating: "
+            f"{[q.name for q in specs if not q.accum]}) — build the "
+            "sub-queries with compressed=True, or drop share_codec"
+        )
+    codec_only = [q.name for q in specs if q.agg.requires_codec]
+    if codec_only and not use_codec:
+        raise ValueError(
+            f"queries {codec_only} fold ONLY through their ingest "
+            "codec (requires_codec) but the fused shared-compress "
+            "stage is not engaged here"
+            + (" (share_codec=False pins the raw path)"
+               if share_codec is False else
+               ": every fused query must be codec-capable and "
+               "accumulating for it to engage")
+            + " — their raw fold does not exist, so the set is "
+            "un-fusable as-is"
+        )
 
     def init():
         st: dict = {STEP_KEY: jnp.zeros((), jnp.int64)}
@@ -291,6 +358,77 @@ def fuse(queries, *, name: str | None = None) -> MultiQueryPlan:
                 out[q.name] = view
         return out
 
+    fused_host_compress = None
+    fused_stack_payloads = None
+    fused_fold_compressed = None
+    fused_payload_check = None
+    if use_codec:
+        def fused_host_compress(chunk):
+            # ONE multi-query compressed payload per chunk: each query's
+            # own codec reduces the SAME chunk, and the dict rides the
+            # pipeline as one unit — one staging pass, one H2D, one
+            # fused dispatch. (The engine's empty identity chunk is not
+            # a stream chunk; keep the counter honest.)
+            if bool(np.any(np.asarray(chunk.valid))):
+                obs_bus.get_bus().inc("multiquery.compressed_chunks")
+            return {q.name: q.agg.host_compress(chunk) for q in specs}
+
+        def fused_stack_payloads(payloads: list, groups: int = 1) -> dict:
+            out: dict = {}
+            for q in specs:
+                subs = [p[q.name] for p in payloads]
+                if q.agg.stack_payloads is not None:
+                    out[q.name] = q.agg.stack_payloads(subs, groups)
+                else:
+                    out[q.name] = jax.tree.map(
+                        lambda *ls: np.stack(
+                            [np.asarray(x) for x in ls]
+                        ),
+                        *subs,
+                    )
+            return out
+
+        def fused_payload_check(payload):
+            # Producer-payload validation fans out per query (each
+            # codec knows its own id ranges); a payload compressed by
+            # a DIFFERENT fused set is named here, not at a KeyError
+            # inside the fold.
+            missing = [q.name for q in specs
+                       if not isinstance(payload, dict)
+                       or q.name not in payload]
+            if missing:
+                raise ValueError(
+                    f"fused compressed payload is missing per-query "
+                    f"sub-payloads {missing} — was it compressed by a "
+                    "different fused plan?"
+                )
+            for q in specs:
+                fn = q.agg.codec_payload_check
+                if fn is not None:
+                    fn(payload[q.name])
+
+        def fused_fold_compressed(state, payload):
+            # One dispatch folds a stacked unit of payload-chunks
+            # ([K, ...] leaves, K static under jit). The step counter
+            # is INERT on the codec path (all-accumulating by the
+            # eligibility rule — no masked merge windows key off it);
+            # it advances by the unit's widest per-query batch so it
+            # stays monotone and unit-aligned. It is NOT numerically
+            # equal to the raw twin's chunk count when every query's
+            # stacker group-combines (fold_batch > 1) or on a sharded
+            # mesh — the engine's checkpoint POSITION, not this leaf,
+            # is the exactly-once authority either way.
+            k = max(
+                jax.tree.leaves(payload[q.name])[0].shape[0]
+                for q in specs
+            )
+            out = {STEP_KEY: state[STEP_KEY] + k}
+            for q in specs:
+                out[q.name] = q.agg.fold_compressed(
+                    state[q.name], payload[q.name]
+                )
+            return out
+
     fused_flatten = None
     if any(q.agg.flatten is not None for q in specs):
         def fused_flatten(state):
@@ -315,6 +453,16 @@ def fuse(queries, *, name: str | None = None) -> MultiQueryPlan:
         combine=combine,
         transform=transform,
         flatten=fused_flatten,
+        host_compress=fused_host_compress,
+        fold_compressed=fused_fold_compressed,
+        stack_payloads=fused_stack_payloads,
+        codec_payload_check=fused_payload_check,
+        # With the shared codec engaged, a codec-only sub-query makes
+        # the WHOLE fused plan codec-only: the engine must refuse a
+        # configuration where the codec cannot engage (mesh-unaligned
+        # batch) instead of falling into the raw fold that would raise
+        # mid-stream.
+        requires_codec=use_codec and bool(codec_only),
         # The fused plan presents as ONE accumulating summary: per-query
         # windowing (for non-accum sub-queries) happens inside the fold,
         # so the engine's single-running-state physical plan carries

@@ -28,6 +28,17 @@ The engine here owns that multiplexing natively:
   backlogged tenant advances at the same chunk rate. Starvation is
   observable: ``tenants.starved_windows`` counts live-tenant lanes
   that went masked in a dispatch.
+- **Compressed tiers** (``add_tier(..., compressed=True)``): lanes
+  fold PRE-COMPRESSED codec payloads — compressed once, at the
+  producer (the submitter's thread via :meth:`MultiTenantEngine.
+  submit_payload`, or a wire client before send) — through a vmapped
+  ``fold_codec``, so the ~0.25 B/edge codec wire win covers tenant
+  streams and the engine never re-pays host compress for bytes the
+  producer shipped compressed. :meth:`TenantBatch.stack_payloads`
+  stacks one payload per lane (variable-length wire keys pad to a
+  shared power-of-two bucket from the plan's ``codec_pad_values``).
+  Snapshots are bit-identical to the raw tier's; ``stack_ordered``
+  codecs are refused (their id sessions need global stream order).
 - **Per-tenant exactly-once checkpoints**: each tenant's lane is
   snapshotted through its own :class:`~gelly_tpu.engine.resilience.
   CheckpointManager` rotation (distinct filename prefixes in one
@@ -87,6 +98,45 @@ def tenant_prefix(tenant_id) -> str:
     )
 
 
+def _normalize_payload(payload):
+    """Host-normalize a pre-compressed tenant payload (a codec
+    ``host_compress`` output: a dict of arrays or one ndarray) for
+    lane stacking."""
+    if isinstance(payload, EdgeChunk):
+        raise ValueError(
+            "got an EdgeChunk on a compressed tier — compressed tiers "
+            "fold pre-compressed codec payloads (the plan's "
+            "host_compress output); compress at the producer or use a "
+            "raw tier (submit())"
+        )
+    if isinstance(payload, dict):
+        out = {}
+        for k, v in payload.items():
+            arr = np.asarray(v)
+            if arr.dtype == object:
+                # np.asarray on e.g. a nested dict "succeeds" as a 0-d
+                # object array — which would poison the tier template
+                # and only blow up later on the scheduler thread; the
+                # raise-to-the-submitter contract demands it fail HERE.
+                raise ValueError(
+                    f"payload key {k!r} is not an array (got "
+                    f"{type(v).__name__}) — compressed tiers take one "
+                    "FLAT dict of arrays (a codec host_compress "
+                    "output); nested payloads (e.g. a fused multi-"
+                    "query codec dict) have no lane-stacking support"
+                )
+            out[k] = arr
+        return out
+    arr = np.asarray(payload)
+    if arr.dtype == object:
+        raise ValueError(
+            f"cannot normalize payload of type "
+            f"{type(payload).__name__} — expected a dict of arrays or "
+            "one ndarray (a codec host_compress output)"
+        )
+    return arr
+
+
 def _normalize_chunk(chunk: EdgeChunk, capacity: int) -> EdgeChunk:
     """Host-normalize a tenant chunk for cross-tenant stacking: fixed
     dtypes for the id columns (folds read the dense ``src``/``dst``
@@ -122,11 +172,38 @@ class TenantBatch:
     """
 
     def __init__(self, agg: SummaryAggregation, chunk_capacity: int,
-                 mesh=None, min_lanes: int = 1):
+                 mesh=None, min_lanes: int = 1,
+                 compressed: bool = False):
         self.agg = agg
         self.chunk_capacity = int(chunk_capacity)
         self.mesh = mesh
         self.min_lanes = max(1, int(min_lanes))
+        # Compressed tier: lanes fold pre-compressed codec payloads
+        # (``fold_codec`` dispatch) instead of raw chunks — the shared
+        # compression plane's "compress once, at the producer" leg.
+        self.compressed = bool(compressed)
+        if compressed and (agg.fold_compressed is None
+                           or agg.host_compress is None):
+            # host_compress is required too: masked lanes fold the
+            # codec's identity payload (host_compress of an empty
+            # chunk), and a missing one would otherwise surface only
+            # at the first dispatch with a drained lane — a config
+            # error that must fail at registration.
+            missing = ("fold_compressed" if agg.fold_compressed is None
+                       else "host_compress")
+            raise ValueError(
+                f"aggregation '{agg.name}' has no {missing} — a "
+                "compressed tier folds pre-compressed codec payloads "
+                "(and pads masked lanes with the codec's identity "
+                "payload); build the plan with its ingest codec on "
+                "(e.g. ingest_combine=True) or register a raw tier"
+            )
+        if agg.requires_codec and not compressed:
+            raise ValueError(
+                f"aggregation '{agg.name}' folds ONLY through its "
+                "ingest codec (requires_codec); register the tier with "
+                "compressed=True so lanes fold payloads, not raw chunks"
+            )
         self.lanes = 0
         self.plan = None
         # The accumulate plan (SummaryAggregation.fold_accumulates): one
@@ -147,6 +224,8 @@ class TenantBatch:
                 self.sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self._zero_chunk: EdgeChunk | None = None
         self._template: EdgeChunk | None = None
+        self._payload_template: dict | None = None
+        self._identity_payload = None
 
     def _width_for(self, n: int) -> int:
         want = max(self.min_lanes, n, 1)
@@ -269,6 +348,117 @@ class TenantBatch:
         active = np.zeros((self.lanes,), bool)
         active[: len(per_lane)] = [c is not None for c in per_lane]
         return stacked, active
+
+    # ------------------------------------------------- compressed tiers
+
+    def _identity(self):
+        # The masked-lane filler payload: the plan's own compression of
+        # an empty chunk (what the engine pads short codec units with).
+        if self._identity_payload is None:
+            from ..core.chunk import make_chunk
+
+            empty = make_chunk(
+                np.zeros(0, np.int64), np.zeros(0, np.int64),
+                capacity=1, device=False,
+            )
+            # host_compress presence is a compressed-tier construction
+            # invariant (__init__ refuses plans without it), so this
+            # call cannot land on None.
+            self._identity_payload = _normalize_payload(
+                self.agg.host_compress(empty)
+            )
+        return self._identity_payload
+
+    def check_payload_template(self, payload) -> None:
+        """Validate a normalized pre-compressed payload against the
+        tier template (first payload seen sets it) — same
+        raise-to-the-submitter timing as :meth:`check_template`. Keys
+        named in the plan's ``codec_pad_values`` may vary in length
+        (they pad to a shared bucket at stack time); everything else
+        must match shape and dtype exactly."""
+        pad = self.agg.codec_pad_values or {}
+
+        bound = 2 * self.chunk_capacity  # two endpoints per edge
+
+        def describe(p):
+            if isinstance(p, dict):
+                out = {}
+                for k, v in p.items():
+                    v = np.asarray(v)
+                    if k in pad:
+                        if v.ndim != 1:
+                            raise ValueError(
+                                f"payload key {k!r} is declared "
+                                "variable-length (codec_pad_values) "
+                                f"but has ndim {v.ndim}; lane padding "
+                                "covers 1-D wire arrays only"
+                            )
+                        if v.shape[0] > bound:
+                            # The raw tier's chunk-capacity bound,
+                            # translated: one tenant's oversized
+                            # payload would otherwise inflate EVERY
+                            # lane's padded bucket (memory + compile
+                            # cache + fold work) — cross-tenant
+                            # interference the tier design forbids.
+                            raise ValueError(
+                                f"payload key {k!r} carries "
+                                f"{v.shape[0]} rows > the tier bound "
+                                f"of 2 x chunk_capacity = {bound} — "
+                                "compress smaller chunks or register "
+                                "a larger tier"
+                            )
+                        out[k] = (v.dtype, None)
+                    else:
+                        out[k] = (v.dtype, v.shape)
+                return out
+            v = np.asarray(p)
+            return {None: (v.dtype, v.shape)}
+
+        tpl = describe(payload)
+        if self._payload_template is None:
+            self._payload_template = tpl
+            return
+        ref = self._payload_template
+        if tpl != ref:
+            raise ValueError(
+                f"tenant payload ({tpl}) differs from the tier "
+                f"template ({ref}) — tenants of a compressed tier must "
+                "ship payloads from the SAME codec (fixed-shape keys "
+                "identical; variable keys are those in the plan's "
+                "codec_pad_values)"
+            )
+
+    def stack_payloads(self, per_lane: list) -> tuple:
+        """Host-stack one pre-compressed payload (or the identity
+        payload for masked lanes) per lane into ``[lanes, 1, ...]``
+        leaves — each lane a K=1 batch, so the very same
+        ``fold_compressed`` the engine's stacked-unit path compiles
+        folds it under vmap — plus the bool[lanes] active mask.
+        Variable-length keys pad to a shared power-of-two bucket
+        (bounded program ladder, like ``bucket_stack_payloads``)."""
+        first = next((p for p in per_lane if p is not None), None)
+        if first is None:
+            raise ValueError("stack_payloads needs at least one live lane")
+        rows = [p if p is not None else self._identity()
+                for p in per_lane]
+        rows += [self._identity()] * (self.lanes - len(per_lane))
+        active = np.zeros((self.lanes,), bool)
+        active[: len(per_lane)] = [p is not None for p in per_lane]
+        pad = self.agg.codec_pad_values or {}
+        if isinstance(first, dict):
+            # The engine's shared variable-length stacker does the
+            # bucket math (one padding implementation, one ladder);
+            # the lane axis just inserts the K=1 batch dim after it.
+            # Bucket floor tracks the tier's own payload bound so tiny
+            # tiers never pad to the global 1024 default.
+            from .aggregation import bucket_stack_payloads
+
+            stacked = bucket_stack_payloads(
+                rows, pad,
+                min_bucket=min(1024, max(1, 2 * self.chunk_capacity)),
+            )
+            return {k: v[:, None] for k, v in stacked.items()}, active
+        return np.stack([np.asarray(r) for r in rows])[:, None], active
 
 
 class _Tenant:
@@ -396,17 +586,27 @@ class MultiTenantEngine:
     # ------------------------------------------------------------ control
 
     def add_tier(self, name: str, agg: SummaryAggregation,
-                 chunk_capacity: int, min_lanes: int = 1) -> None:
+                 chunk_capacity: int, min_lanes: int = 1,
+                 compressed: bool = False) -> None:
         """Register a capacity tier: one plan + one chunk shape, shared
         by every tenant admitted into it. Plan constraints are
-        validated at first lane build (see ``_compiled_tenant_plan``)."""
+        validated at first lane build (see ``_compiled_tenant_plan``).
+
+        ``compressed=True`` registers a COMPRESSED tier: tenants ship
+        pre-compressed codec payloads (the plan's ``host_compress``
+        output — compressed once, at the producer: the submitter's
+        thread, or a wire client before send) via :meth:`submit_payload`
+        / payload pull sources, and every scheduling round dispatches
+        the vmapped ``fold_codec`` instead of the raw fold. Requires a
+        plan with a stateless codec (``fold_compressed`` present, no
+        ``stack_ordered``); bit-identical snapshots to the raw tier."""
         with self._lock:
             if name in self._tiers:
                 raise ValueError(f"tier {name!r} already registered")
             self._tiers[name] = _Tier(
                 name,
                 TenantBatch(agg, chunk_capacity, mesh=self.mesh,
-                            min_lanes=min_lanes),
+                            min_lanes=min_lanes, compressed=compressed),
             )
 
     def admit(self, tenant_id, tier: str, chunks=None) -> int:
@@ -506,9 +706,47 @@ class MultiTenantEngine:
                     f"tenant {tenant_id!r} is finished; no more chunks"
                 )
             batch = self._tiers[t.tier].batch
+        if batch.compressed:
+            raise ValueError(
+                f"tier {t.tier!r} is a compressed tier: it folds "
+                "pre-compressed codec payloads — compress at the "
+                "producer (the plan's host_compress) and use "
+                "submit_payload()"
+            )
         h = _normalize_chunk(chunk, batch.chunk_capacity)
         with self._lock:
             batch.check_template(h)
+            t.queue.append(h)
+        self._work.set()
+
+    def submit_payload(self, tenant_id, payload) -> None:
+        """Push one PRE-COMPRESSED codec payload (the tier plan's
+        ``host_compress`` output) onto a compressed-tier tenant's queue
+        (any thread) — the producer-side half of the shared compression
+        plane: the engine never re-compresses what the submitter (or a
+        wire client) already reduced. Raises to the caller on a payload
+        that doesn't match the tier's codec template."""
+        with self._lock:
+            t = self._tenants[tenant_id]
+            if t.finished:
+                raise ValueError(
+                    f"tenant {tenant_id!r} is finished; no more chunks"
+                )
+            batch = self._tiers[t.tier].batch
+        if not batch.compressed:
+            raise ValueError(
+                f"tier {t.tier!r} is a raw tier (add_tier("
+                "compressed=False)); submit() chunks instead, or "
+                "register the tier with compressed=True"
+            )
+        h = _normalize_payload(payload)
+        if batch.agg.codec_payload_check is not None:
+            # The plan's own id range check (payload_to_chunk parity):
+            # an out-of-range id raises HERE, on the producer, instead
+            # of silently dropping/clamping in the device scatter.
+            batch.agg.codec_payload_check(h)
+        with self._lock:
+            batch.check_payload_template(h)
             t.queue.append(h)
         self._work.set()
 
@@ -655,11 +893,22 @@ class MultiTenantEngine:
             batch = self._tiers[t.tier].batch
             try:
                 chunk = next(t.source, None)
-                h = (None if chunk is None
-                     else _normalize_chunk(chunk, batch.chunk_capacity))
+                if chunk is None:
+                    h = None
+                elif batch.compressed:
+                    # Compressed tiers pull PAYLOAD sources: the
+                    # producer side of the stream already compressed.
+                    h = _normalize_payload(chunk)
+                    if batch.agg.codec_payload_check is not None:
+                        batch.agg.codec_payload_check(h)
+                else:
+                    h = _normalize_chunk(chunk, batch.chunk_capacity)
                 with self._lock:
                     if h is None:
                         t.finished = True
+                    elif batch.compressed:
+                        batch.check_payload_template(h)
+                        t.queue.append(h)
                     else:
                         batch.check_template(h)
                         t.queue.append(h)
@@ -772,11 +1021,16 @@ class MultiTenantEngine:
             t0 = tracer.now() if tracer is not None else 0.0
             with self._dispatch_lock:
                 batch.ensure_lanes(len(per_lane))
-                stacked, active = batch.stack_chunks(per_lane)
+                if batch.compressed:
+                    stacked, active = batch.stack_payloads(per_lane)
+                    fold = batch.plan.fold_codec
+                else:
+                    stacked, active = batch.stack_chunks(per_lane)
+                    fold = batch.plan.fold
                 dev = jax.device_put(stacked, batch.sharding)
                 act = jax.device_put(active, batch.sharding)
                 # ONE donated dispatch advances every lane of the tier.
-                batch.state = batch.plan.fold(batch.state, dev, act)
+                batch.state = fold(batch.state, dev, act)
             with self._lock:
                 for t in took:
                     t.consumed += 1
@@ -789,6 +1043,8 @@ class MultiTenantEngine:
             if starved:
                 bus.inc("tenants.starved_windows", starved)
             bus.inc("tenants.dispatches")
+            if batch.compressed:
+                bus.inc("tenants.compressed_dispatches")
             bus.inc("tenants.chunks_folded", len(took))
             if tracer is not None:
                 tracer.span(

@@ -21,6 +21,12 @@ the engine:
   frames, per-stream sequence numbers, CRC32 per frame (the checkpoint
   CRC discipline applied to the wire), and a dict-of-ndarray payload
   codec carrying the existing ~0.25-byte/edge compressed chunk format.
+  ``DATA_COMPRESSED`` frames carry payloads the CLIENT already ran
+  through the plan's ingest codec — same seq/CRC/resume/ack semantics
+  as ``DATA``, zero server-side compress (the shared compression
+  plane's wire leg; consume via ``IngestServer.compressed_payloads``
+  + ``run_aggregation(precompressed=True)`` or a compressed tenant
+  tier).
 - :mod:`~gelly_tpu.ingest.server` / :mod:`~gelly_tpu.ingest.client` —
   a socket ingestion server with gauge-driven backpressure (PAUSE when
   ``pipeline.staged_depth`` exceeds the high-water mark) and a client

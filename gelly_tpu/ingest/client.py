@@ -158,26 +158,38 @@ class IngestClient:
 
     # ------------------------------------------------------------ sending
 
-    def send(self, payload: dict) -> int:
+    def send(self, payload: dict, *, compressed: bool = False) -> int:
         """Frame + transmit one payload dict; returns its seq. Blocks
-        while the server holds the stream PAUSEd (backpressure)."""
+        while the server holds the stream PAUSEd (backpressure).
+        ``compressed=True`` marks the payload as PRE-COMPRESSED (a
+        codec ``host_compress`` output) — it rides the same seq space
+        and resend buffer, framed ``DATA_COMPRESSED`` so the server
+        admits it with zero server-side compress work."""
         faults_mod.inject("ingest")
         if not self._resume_evt.wait(self.send_pause_timeout):
             raise IngestError(
                 f"stream PAUSEd longer than {self.send_pause_timeout}s — "
                 "is the consumer stalled past the backpressure window?"
             )
+        ftype = wire.DATA_COMPRESSED if compressed else wire.DATA
         with self._lock:
             self._raise_rx_error_locked()
             seq = self._next_seq
             frame = wire.pack_frame(
-                wire.DATA, seq, wire.pack_payload(payload)
+                ftype, seq, wire.pack_payload(payload)
             )
             self._unacked[seq] = frame
             self._next_seq = seq + 1
         self._raw_send(frame)
         obs_bus.get_bus().inc("ingest.frames_sent")
         return seq
+
+    def send_compressed(self, payload: dict) -> int:
+        """:meth:`send` with ``compressed=True`` — the client-side leg
+        of the shared compression plane: compress once here (the
+        plan's ``host_compress``), and the server/engine fold the
+        payload directly."""
+        return self.send(payload, compressed=True)
 
     def send_edges(self, src, dst, chunk_size: int = 4096) -> int:
         """Chunk raw (src, dst) arrays into DATA frames; returns the

@@ -19,6 +19,14 @@ Delivery contract:
   and the expected seq does NOT advance; the client retransmits. A
   torn frame (connection died mid-frame) ends the connection without
   enqueueing anything.
+- **Pre-compressed DATA frames.** A ``DATA_COMPRESSED`` frame carries
+  a payload the CLIENT already ran through the plan's ingest codec
+  (``host_compress``): it rides the same seq/CRC/duplicate/resume/ack
+  machinery as DATA, but is admitted straight into staging — the
+  consumer folds it directly (``run_aggregation(precompressed=True)``
+  or a compressed tenant tier) and the server performs ZERO compress
+  work. ``ingest.data_frames_raw`` / ``ingest.data_frames_compressed``
+  count the two kinds.
 - **Acks follow durability, not receipt.** With ``auto_ack=True``
   (lossy-tolerant pipelines) a frame is acked once enqueued. With
   ``auto_ack=False`` the CONSUMER calls :meth:`ack` after its own
@@ -195,10 +203,13 @@ class IngestServer:
 
     # ----------------------------------------------------------- consumer
 
-    def payloads(self) -> Iterator[tuple[int, dict]]:
-        """Yield ``(seq, payload_dict)`` in sequence order until
-        :meth:`stop`. The bounded staging queue is the backpressure
-        boundary: not consuming stalls the wire, never memory."""
+    def frames(self) -> Iterator[tuple[int, dict, bool]]:
+        """Yield ``(seq, payload_dict, compressed)`` in sequence order
+        until :meth:`stop` — ``compressed`` is True for
+        ``DATA_COMPRESSED`` frames (client-side-compressed codec
+        payloads the consumer folds directly, no server compress). The
+        bounded staging queue is the backpressure boundary: not
+        consuming stalls the wire, never memory."""
         import queue as queue_mod
 
         while True:
@@ -212,12 +223,43 @@ class IngestServer:
                 return
             yield item
 
+    def payloads(self) -> Iterator[tuple[int, dict]]:
+        """Yield ``(seq, payload_dict)`` in sequence order until
+        :meth:`stop` (see :meth:`frames` for the variant that also
+        reports the compressed flag)."""
+        for seq, payload, _compressed in self.frames():
+            yield seq, payload
+
+    def compressed_payloads(self) -> Iterator[dict]:
+        """Yield pre-compressed codec payloads in sequence order — the
+        stream ``run_aggregation(..., precompressed=True)`` folds with
+        zero server-side compress spans. A raw DATA frame on the
+        stream is a protocol error here (the consumer's fold has no
+        raw-chunk path wired): raised loudly, never silently folded."""
+        for seq, payload, compressed in self.frames():
+            if not compressed:
+                raise ValueError(
+                    f"raw DATA frame at seq {seq} on a compressed-"
+                    "payload consumer — the client must compress before "
+                    "send (send_compressed / DATA_COMPRESSED); mixing "
+                    "raw and compressed chunks in one stream has no "
+                    "single fold to land in"
+                )
+            yield payload
+
     def chunks(self, capacity: int,
                vertex_capacity: int | None = None) -> Iterator:
         """Raw-edge payload stream as padded EdgeChunks (see
         :func:`payload_to_chunk`; pass the stream's ``vertex_capacity``
         so out-of-range wire ids fail loudly, file-ingest parity)."""
-        for _seq, payload in self.payloads():
+        for seq, payload, compressed in self.frames():
+            if compressed:
+                raise ValueError(
+                    f"compressed DATA frame at seq {seq} on a raw-chunk "
+                    "consumer — this stream folds raw edges "
+                    "(payload_to_chunk); consume compressed_payloads() "
+                    "with a codec plan instead"
+                )
             yield payload_to_chunk(payload, capacity, vertex_capacity)
 
     def ack(self, upto: int) -> None:
@@ -334,8 +376,9 @@ class IngestServer:
                     if self.stop_on_bye:
                         self.stop()
                     return
-                if ftype != wire.DATA:
+                if ftype not in (wire.DATA, wire.DATA_COMPRESSED):
                     continue  # unexpected control frame: ignore
+                compressed = ftype == wire.DATA_COMPRESSED
                 with self._state_lock:
                     expect = self._next_seq
                 if seq < expect:
@@ -363,7 +406,7 @@ class IngestServer:
                 # mark). Frames the client already pushed into kernel
                 # buffers wait there under TCP flow control.
                 self._apply_backpressure(sock, bus)
-                if not self._enqueue((seq, data)):
+                if not self._enqueue((seq, data, compressed)):
                     return  # stopped while staging
                 with self._state_lock:
                     self._next_seq = seq + 1
@@ -371,6 +414,10 @@ class IngestServer:
                         self._acked = seq + 1
                     acked = self._acked
                 bus.inc("ingest.chunks_enqueued")
+                if compressed:
+                    bus.inc("ingest.data_frames_compressed")
+                else:
+                    bus.inc("ingest.data_frames_raw")
                 bus.gauge("ingest.staged_depth", self._q.qsize())
                 if tracer is not None:
                     tracer.instant("ingest.chunk_staged", track="ingest",
@@ -506,7 +553,7 @@ class TenantRouter:
     def _drain_loop(self, server: IngestServer, default_tenant) -> None:
         bus = obs_bus.get_bus()
         chunk_capacity = self.engine.chunk_capacity(self.tier)
-        for seq, payload in server.payloads():
+        for seq, payload, compressed in server.frames():
             if self._stop.is_set():
                 break
             # Per-payload containment: a malformed payload (out-of-range
@@ -526,10 +573,18 @@ class TenantRouter:
                         "default); dropped", wire_tenant,
                     )
                     continue
-                chunk = payload_to_chunk(
-                    payload, chunk_capacity, self.vertex_capacity
-                )
-                self.engine.submit(tid, chunk)
+                if compressed:
+                    # Client-side-compressed payload straight into the
+                    # compressed tier's queue: no payload_to_chunk, no
+                    # server-side compress — the engine folds exactly
+                    # the bytes the producer shipped (a raw tier
+                    # refuses it below, counted invalid).
+                    self.engine.submit_payload(tid, payload)
+                else:
+                    chunk = payload_to_chunk(
+                        payload, chunk_capacity, self.vertex_capacity
+                    )
+                    self.engine.submit(tid, chunk)
             except Exception as e:  # noqa: BLE001
                 bus.inc("ingest.chunks_invalid")
                 logger.warning(

@@ -11,11 +11,12 @@ without re-compressing.
 Frame layout (network byte order)::
 
     magic  u16   0x4749 ("GI")
-    type   u8    HELLO/WELCOME/DATA/ACK/REJECT/PAUSE/RESUME/BYE
+    type   u8    HELLO/WELCOME/DATA/ACK/REJECT/PAUSE/RESUME/BYE/
+                 DATA_COMPRESSED
     flags  u8    reserved (0)
-    seq    u64   per-stream sequence number (DATA: the chunk position;
-                 ACK/REJECT/WELCOME: the position being acknowledged /
-                 expected)
+    seq    u64   per-stream sequence number (DATA/DATA_COMPRESSED: the
+                 chunk position; ACK/REJECT/WELCOME: the position being
+                 acknowledged / expected)
     len    u32   payload byte length
     crc    u32   zlib.crc32 of the payload bytes
 
@@ -42,14 +43,22 @@ HEADER_BYTES = _HEADER.size
 # Frame types.
 HELLO = 1    # client -> server: open/resume a stream
 WELCOME = 2  # server -> client: carries the server's next expected seq
-DATA = 3     # client -> server: one compressed chunk payload
+DATA = 3     # client -> server: one raw-edge chunk payload
 ACK = 4      # server -> client: every seq < value is durably folded
 REJECT = 5   # server -> client: frame refused; value = expected seq
 PAUSE = 6    # server -> client: backpressure — stop sending
 RESUME = 7   # server -> client: backpressure released
 BYE = 8      # either side: orderly close
+# One CLIENT-SIDE-COMPRESSED chunk payload (a codec host_compress
+# output — e.g. the sparse CC pairs at ~0.25 B/edge): rides the same
+# per-stream seq space, CRC discipline, duplicate/gap handling, resume
+# and ack semantics as DATA, but the server admits it straight into
+# staging — zero server-side compress work for bytes the producer
+# already reduced (the shared compression plane's wire leg).
+DATA_COMPRESSED = 9
 
-FRAME_TYPES = (HELLO, WELCOME, DATA, ACK, REJECT, PAUSE, RESUME, BYE)
+FRAME_TYPES = (HELLO, WELCOME, DATA, ACK, REJECT, PAUSE, RESUME, BYE,
+               DATA_COMPRESSED)
 
 # Bound on a single payload (64 MiB): a length prefix beyond it is
 # treated as a corrupt header, not an allocation request.

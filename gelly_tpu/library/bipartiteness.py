@@ -115,7 +115,10 @@ def bipartiteness_check(vertex_capacity: int,
     ``vertex_capacity >= SPARSE_CODEC_MIN_CAPACITY``); see
     :func:`~gelly_tpu.library.connected_components.connected_components`.
     """
-    from ..engine.aggregation import resolve_sparse_codec
+    from ..engine.aggregation import (
+        resolve_sparse_codec,
+        sparse_payload_id_check,
+    )
 
     n = vertex_capacity
     sparse = resolve_sparse_codec(codec, n)
@@ -254,22 +257,36 @@ def bipartiteness_check(vertex_capacity: int,
         stack_payloads=(
             stack_sparse if (ingest_combine and sparse) else None
         ),
+        # Sparse-triple wire pad values (tenant compressed tiers stack
+        # per-chunk payloads themselves; -1 lanes fold as no-ops) +
+        # the producer-payload id range check (wire-ingest parity).
+        codec_pad_values=(
+            {"v": -1, "r": 0, "p": 0}
+            if (ingest_combine and sparse) else None
+        ),
+        codec_payload_check=(
+            sparse_payload_id_check(n, "v", "r")
+            if (ingest_combine and sparse) else None
+        ),
         fold_accumulates=True,  # parity forests are pure edge-set summaries
         name="bipartiteness-check",
     )
 
 
 def bipartiteness_query(vertex_capacity: int, *,
-                        name: str = "bipartiteness"):
+                        name: str = "bipartiteness",
+                        compressed: bool = False, codec: str = "auto"):
     """Fuse-compatible bipartiteness query (``engine.multiquery.fuse``):
-    the raw parity-union fold (``ingest_combine=False`` — see
+    the parity-union fold (``ingest_combine=False`` by default — see
     :func:`~gelly_tpu.library.connected_components.cc_query` for the
-    shared-chunk rationale)."""
+    shared-chunk rationale; ``compressed=True`` keeps the parity codec
+    on for fused codec sharing)."""
     from ..engine.multiquery import QuerySpec
 
     return QuerySpec(
         name=name,
-        agg=bipartiteness_check(vertex_capacity, ingest_combine=False),
+        agg=bipartiteness_check(vertex_capacity,
+                                ingest_combine=compressed, codec=codec),
         slot_capacity=vertex_capacity,
     )
 

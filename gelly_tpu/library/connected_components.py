@@ -30,6 +30,7 @@ from ..core.stream import EdgeStream
 from ..engine.aggregation import (  # noqa: F401  (threshold re-exported)
     SPARSE_CODEC_MIN_CAPACITY,
     SummaryAggregation,
+    sparse_payload_id_check,
 )
 from ..ops import segments, unionfind
 from ..ops.pallas_kernels import on_tpu as pallas_on_tpu
@@ -606,24 +607,29 @@ def resolve_fold_backend(fold_backend: str, vertex_capacity: int) -> str:
 def cc_tenant_tier(
     vertex_capacity: int, chunk_capacity: int = 1 << 10,
     fold_backend: str = "auto", delta_auto_rows: int | None = None,
+    compressed: bool = False, codec: str = "auto",
 ) -> tuple[SummaryAggregation, int]:
     """Build a CC plan suitable for one multi-tenant capacity tier
     (``engine/tenants.py``) — returns ``(agg, chunk_capacity)`` for
     ``MultiTenantEngine.add_tier``.
 
-    Tenant batching vmaps the RAW fold over the tenant axis, so the
-    tier plan must fold raw chunks: the stateful compact-id codec
-    (``codec="compact"``) is per-run host state a stacked batch cannot
-    share, and the host-compress codecs never engage (the tenant
-    engine has no per-tenant compress stage — per-tenant chunks are
-    small, which is exactly why batching, not codec compression, is
-    the scarce-resource lever there). ``vertex_capacity`` is the
-    tier's capacity class: all tenants of the tier share one compiled
-    program per lane width, so admit tenants into the smallest tier
-    whose capacity covers them.
+    ``compressed=False`` (default) builds the raw-fold tier: the
+    stacked batch vmaps ``fold`` over raw per-tenant chunks.
+    ``compressed=True`` keeps the stateless ingest codec ON, for a
+    ``add_tier(..., compressed=True)`` tier whose lanes fold
+    PRE-COMPRESSED payloads (compressed once at the producer — the
+    submitter or a wire client; ``codec`` picks the payload format,
+    ``"sparse"`` being the wire-win shape). The stateful compact-id
+    codec (``codec="compact"``) stays unusable either way: its
+    id-assignment session consumes payloads in global stream order,
+    which concurrent tenant lanes cannot provide. ``vertex_capacity``
+    is the tier's capacity class: all tenants of the tier share one
+    compiled program per lane width, so admit tenants into the
+    smallest tier whose capacity covers them.
     """
     agg = connected_components(
-        vertex_capacity, merge="gather", ingest_combine=False,
+        vertex_capacity, merge="gather", ingest_combine=compressed,
+        codec=codec,
         fold_backend=fold_backend, delta_auto_rows=delta_auto_rows,
     )
     return agg, int(chunk_capacity)
@@ -870,6 +876,18 @@ def connected_components(
         stack_payloads=(
             stack_sparse if (ingest_combine and sparse) else None
         ),
+        # Wire pad values of the sparse pair payload (consumers that
+        # stack per-chunk payloads themselves — the tenant engine's
+        # compressed tiers — pad with these; -1 lanes fold as no-ops),
+        # and the producer-payload id range check (wire-ingest parity:
+        # out-of-range ids raise at staging, never silently clamp).
+        codec_pad_values=(
+            {"v": -1, "r": 0} if (ingest_combine and sparse) else None
+        ),
+        codec_payload_check=(
+            sparse_payload_id_check(n, "v", "r")
+            if (ingest_combine and sparse) else None
+        ),
         fold_accumulates=True,  # CC forests are pure edge-set summaries
         flatten=flatten,
         fold_backend=backend,
@@ -889,18 +907,29 @@ def connected_components(
 
 
 def cc_query(vertex_capacity: int, *, name: str = "cc",
-             merge: str = "gather", fold_backend: str = "auto"):
-    """Fuse-compatible CC query (``engine.multiquery.fuse``): the raw
-    fold (``ingest_combine=False`` — the fused pipeline stages each
-    chunk exactly once for EVERY query, so per-query codecs never
-    engage), tagged with this plan's slot capacity so ``fuse`` can
-    refuse mismatched chunk schemas."""
+             merge: str = "gather", fold_backend: str = "auto",
+             compressed: bool = False, codec: str = "auto"):
+    """Fuse-compatible CC query (``engine.multiquery.fuse``), tagged
+    with this plan's slot capacity so ``fuse`` can refuse mismatched
+    chunk schemas.
+
+    ``compressed=False`` (default) builds the raw fold
+    (``ingest_combine=False``): the fused pipeline stages each chunk
+    exactly once for every query, and per-query codecs never engage.
+    ``compressed=True`` keeps the ingest codec ON — when EVERY query
+    of a fused set does, the fused plan's shared compress stage emits
+    one multi-query compressed payload per chunk and the folds run
+    through ``fold_compressed`` (the codec's ~0.25 B/edge wire win,
+    recovered for fused runs). ``codec`` picks the payload format as
+    in :func:`connected_components` (``"compact"`` is stack-ordered
+    and un-fusable)."""
     from ..engine.multiquery import QuerySpec
 
     return QuerySpec(
         name=name,
         agg=connected_components(vertex_capacity, merge=merge,
-                                 ingest_combine=False,
+                                 ingest_combine=compressed,
+                                 codec=codec,
                                  fold_backend=fold_backend),
         slot_capacity=vertex_capacity,
     )

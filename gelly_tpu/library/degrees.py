@@ -48,6 +48,7 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
     from ..engine.aggregation import (
         SummaryAggregation,
         resolve_sparse_codec,
+        sparse_payload_id_check,
     )
 
     n = vertex_capacity
@@ -170,17 +171,29 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
         stack_payloads=(
             stack_sparse if (ingest_combine and sparse) else None
         ),
+        # Sparse-pair wire pad values (tenant compressed tiers stack
+        # per-chunk payloads themselves; -1 lanes fold as no-ops) +
+        # the producer-payload id range check (wire-ingest parity).
+        codec_pad_values=(
+            {"v": -1, "d": 0} if (ingest_combine and sparse) else None
+        ),
+        codec_payload_check=(
+            sparse_payload_id_check(n, "v")
+            if (ingest_combine and sparse) else None
+        ),
         fold_accumulates=True,  # degree vectors add elementwise
         name="degree-aggregate",
     )
 
 
 def degrees_query(vertex_capacity: int, *, name: str = "degrees",
-                  count_out: bool = True, count_in: bool = True):
+                  count_out: bool = True, count_in: bool = True,
+                  compressed: bool = False, codec: str = "auto"):
     """Fuse-compatible degree query (``engine.multiquery.fuse``): the
-    raw ±1-scatter fold (``ingest_combine=False`` — see
+    ±1-scatter fold (``ingest_combine=False`` by default — see
     :func:`~gelly_tpu.library.connected_components.cc_query` for the
-    shared-chunk rationale). ``count_out``/``count_in`` pick the
+    shared-chunk rationale; ``compressed=True`` keeps the delta codec
+    on for fused codec sharing). ``count_out``/``count_in`` pick the
     direction, so e.g. out- and in-degree can ride one fused dispatch
     as two named queries."""
     from ..engine.multiquery import QuerySpec
@@ -188,7 +201,8 @@ def degrees_query(vertex_capacity: int, *, name: str = "degrees",
     return QuerySpec(
         name=name,
         agg=degree_aggregate(vertex_capacity, count_out=count_out,
-                             count_in=count_in, ingest_combine=False),
+                             count_in=count_in,
+                             ingest_combine=compressed, codec=codec),
         slot_capacity=vertex_capacity,
     )
 

@@ -36,6 +36,10 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``ingest.backpressure_engaged``       PAUSE engagements (event)
 ``ingest.staged_depth``               server staging queue depth (gauge)
 ``ingest.paused``                     1 while PAUSEd (gauge)
+``ingest.data_frames_raw``            raw-edge DATA frames staged
+``ingest.data_frames_compressed``     client-side-compressed
+                                      DATA_COMPRESSED frames staged
+                                      (zero server-side compress)
 ``ingest.frames_sent``                client DATA frames transmitted
 ``ingest.frames_resent``              client retransmits after rewind
 ``ingest.pauses_received``            PAUSE frames seen by the client
@@ -71,6 +75,9 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``tenants.windows_closed``            tenant merge windows closed
 ``tenants.checkpoints``               per-tenant checkpoint writes
 ``tenants.checkpoint_bytes``          cumulative tenant ckpt bytes
+``tenants.compressed_dispatches``     vmapped fold_codec dispatches
+                                      (compressed tiers folding
+                                      producer-compressed payloads)
 ``tenants.reclaims``                  idle-lane reclamation events
                                       (tier lane stack halved)
 ``tenants.lanes_reclaimed``           lanes freed by idle-lane
@@ -78,6 +85,9 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``multiquery.runs``                   fused multi-query runs started
 ``multiquery.fused_queries``          queries riding the active fused
                                       plan (gauge)
+``multiquery.compressed_chunks``      chunks through the fused
+                                      shared-compress stage (one
+                                      multi-query payload per chunk)
 ``multiquery.emissions``              per-query emissions published
                                       (Q per window close)
 ``multiquery.snapshot_reads``         live per-query snapshot reads

@@ -12,6 +12,14 @@ state carries chunk/edge counters (union is idempotent, counters are
 not), so the parent's exactly-once assertion is sharp.
 
 argv: <ckpt_dir> <port_file> <out_npz> <total_chunks> [chunk_sleep_s]
+     [mode: raw|compressed]
+
+``mode=compressed`` consumes CLIENT-COMPRESSED ``DATA_COMPRESSED``
+frames instead (the parent sends sparse CC (v, root) pairs via
+``send_compressed``): the pairs are union edges, so the SAME fold
+applies — the child additionally asserts every staged frame really
+carried the compressed flag, proving acked *compressed* chunks are
+never double-folded either.
 """
 
 import os
@@ -44,8 +52,13 @@ def _find(parent: np.ndarray, v: int) -> int:
 
 def fold(state: dict, payload: dict) -> dict:
     parent = state["parent"].copy()
-    src = np.asarray(payload["src"])
-    dst = np.asarray(payload["dst"])
+    # Raw payloads carry (src, dst) edges; compressed ones carry the
+    # sparse codec's (v, root) pairs — themselves union edges, so one
+    # fold serves both modes and the exactly-once counters stay sharp.
+    src = np.asarray(payload["src"] if "src" in payload
+                     else payload["v"])
+    dst = np.asarray(payload["dst"] if "dst" in payload
+                     else payload["r"])
     for a, b in zip(src.tolist(), dst.tolist()):
         ra, rb = _find(parent, a), _find(parent, b)
         if ra != rb:
@@ -68,6 +81,7 @@ def main(argv):
     ckpt_dir, port_file, out_path = argv[0], argv[1], argv[2]
     total = int(argv[3])
     sleep_s = float(argv[4]) if len(argv) > 4 else 0.0
+    compressed = len(argv) > 5 and argv[5] == "compressed"
 
     from gelly_tpu.engine.checkpoint import save_checkpoint
     from gelly_tpu.engine.resilience import CheckpointManager
@@ -90,10 +104,14 @@ def main(argv):
     os.replace(tmp, port_file)
 
     try:
-        for seq, payload in srv.payloads():
+        for seq, payload, is_comp in srv.frames():
             if sleep_s:
                 time.sleep(sleep_s)
             assert seq == pos, f"sequence skew: frame {seq} at position {pos}"
+            assert is_comp == compressed, (
+                f"frame {seq}: compressed flag {is_comp} != mode "
+                f"{compressed}"
+            )
             state = fold(state, payload)
             pos = seq + 1
             if pos % CKPT_EVERY == 0:

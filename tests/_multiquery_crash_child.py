@@ -33,6 +33,10 @@ from gelly_tpu.library.spanner import spanner_query  # noqa: E402
 N_EDGES = int(os.environ.get("GELLY_MQ_EDGES", "1024"))
 N_V = int(os.environ.get("GELLY_MQ_NV", "96"))
 CHUNK = int(os.environ.get("GELLY_MQ_CHUNK", "32"))
+# GELLY_MQ_COMPRESSED=1 runs the fused-CODEC plan instead (the shared
+# compress stage + fold_compressed path): the kill must land with
+# compressed payload units in flight and resume bit-identically too.
+COMPRESSED = os.environ.get("GELLY_MQ_COMPRESSED", "0") == "1"
 
 
 def build_stream():
@@ -45,6 +49,17 @@ def build_stream():
 
 
 def build_queries():
+    if COMPRESSED:
+        from gelly_tpu.library.bipartiteness import bipartiteness_query
+
+        # The fused-codec set is all-accumulating by construction (the
+        # shared compress stage's eligibility rule); the step counter
+        # still rides the checkpoint and must resume exactly.
+        return [
+            cc_query(N_V, compressed=True, codec="sparse"),
+            degrees_query(N_V, compressed=True, codec="sparse"),
+            bipartiteness_query(N_V, compressed=True, codec="sparse"),
+        ]
     return [
         cc_query(N_V),
         degrees_query(N_V),

@@ -33,6 +33,11 @@ TENANTS = int(os.environ.get("GELLY_TEN_TENANTS", "3"))
 N_EDGES = int(os.environ.get("GELLY_TEN_EDGES", "768"))
 N_V = int(os.environ.get("GELLY_TEN_NV", "96"))
 CHUNK = int(os.environ.get("GELLY_TEN_CHUNK", "16"))
+# GELLY_TEN_COMPRESSED=1 runs a COMPRESSED tier: the sources compress
+# each chunk at the producer (the pull thread) and lanes fold the
+# payloads via fold_codec — the kill must land mid-window and the
+# per-tenant payload-position resume must stay exactly-once too.
+COMPRESSED = os.environ.get("GELLY_TEN_COMPRESSED", "0") == "1"
 
 
 def build_stream(tid: int):
@@ -44,12 +49,12 @@ def build_stream(tid: int):
     )
 
 
-def throttled(stream, sleep_s: float):
+def throttled(stream, sleep_s: float, compress=None):
     def gen(position: int):
         for c in stream.chunks_from(position):
             if sleep_s:
                 time.sleep(sleep_s)
-            yield c
+            yield c if compress is None else compress(c)
 
     return gen  # a callable position -> iterator (seekable)
 
@@ -57,14 +62,20 @@ def throttled(stream, sleep_s: float):
 def main(argv):
     ckpt_dir, out_path = argv[0], argv[1]
     sleep_s = float(argv[2]) if len(argv) > 2 else 0.0
-    agg, cap = cc_tenant_tier(N_V, chunk_capacity=CHUNK)
+    agg, cap = cc_tenant_tier(
+        N_V, chunk_capacity=CHUNK, compressed=COMPRESSED,
+        codec="sparse" if COMPRESSED else "auto",
+    )
     eng = MultiTenantEngine(
         merge_every=2, checkpoint_dir=ckpt_dir, checkpoint_every=1,
         resume=True,
     )
-    eng.add_tier("cc", agg, cap)
+    eng.add_tier("cc", agg, cap, compressed=COMPRESSED)
+    compress = agg.host_compress if COMPRESSED else None
     for tid in range(TENANTS):
-        eng.admit(tid, "cc", chunks=throttled(build_stream(tid), sleep_s))
+        eng.admit(tid, "cc",
+                  chunks=throttled(build_stream(tid), sleep_s,
+                                   compress=compress))
     out = eng.drain()
     save_checkpoint(
         out_path, [np.asarray(out[tid]) for tid in range(TENANTS)],

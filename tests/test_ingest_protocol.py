@@ -356,6 +356,256 @@ def test_ingest_fault_boundary_fires_on_send():
 
 
 # --------------------------------------------------------------------- #
+# pre-compressed DATA frames (the shared compression plane's wire leg)
+
+
+def _cc_chunks(n_v=1 << 10, chunk=256, chunks=6, seed=3):
+    from gelly_tpu.core.chunk import make_chunk
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(chunks):
+        s = rng.integers(0, n_v, chunk).astype(np.int64)
+        d = rng.integers(0, n_v, chunk).astype(np.int64)
+        out.append(make_chunk(s.astype(np.int32), d.astype(np.int32),
+                              raw_src=s, raw_dst=d, capacity=chunk,
+                              device=False))
+    return out
+
+
+def test_compressed_frames_ride_the_same_contract():
+    """DATA_COMPRESSED frames share the seq space with DATA: frames()
+    reports the compressed flag per frame, both kinds count into their
+    own ``ingest.data_frames_*`` counters, and in-order delivery/acks
+    are unchanged."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+
+            def run():
+                for item in srv.frames():
+                    got.append(item)
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                cli.send(edge_payload([0], [1]))
+                cli.send_compressed({"v": np.arange(3, dtype=np.int32),
+                                     "r": np.zeros(3, np.int32)})
+                cli.send(edge_payload([2], [3]))
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+        assert [(s, c) for s, _p, c in got] == [
+            (0, False), (1, True), (2, False)
+        ]
+        np.testing.assert_array_equal(got[1][1]["v"], np.arange(3))
+        snap = bus.snapshot()["counters"]
+        assert snap["ingest.data_frames_raw"] == 2
+        assert snap["ingest.data_frames_compressed"] == 1
+
+
+def test_corrupt_compressed_frame_rejected_and_retransmitted():
+    """CRC-corrupted DATA_COMPRESSED frame: REJECT + counted, the
+    expected seq never advances past the bad bytes, and the client
+    retransmits in place — exactly the DATA contract."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            cli.send_compressed({"v": np.asarray([0], np.int32),
+                                 "r": np.asarray([0], np.int32)})
+            cli.flush(timeout=10)
+            body = pack_payload({"v": np.asarray([7], np.int32),
+                                 "r": np.asarray([0], np.int32)})
+            frame = bytearray(pack_frame(wire.DATA_COMPRESSED, 1, body))
+            frame[-1] ^= 0xFF
+            with cli._send_lock:
+                cli._sock.sendall(bytes(frame))
+            deadline = time.monotonic() + 5
+            while (bus.snapshot()["counters"].get(
+                    "ingest.frames_rejected", 0) < 1
+                    and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.next_seq == 1  # never advanced past bad bytes
+            cli.send_compressed({"v": np.asarray([7], np.int32),
+                                 "r": np.asarray([0], np.int32)})
+            cli.flush(timeout=10)
+            cli.close()
+        t.join(timeout=5)
+        snap = bus.snapshot()["counters"]
+        assert snap["ingest.frames_rejected"] >= 1
+        assert [s for s, _ in got] == [0, 1]
+        assert got[1][1]["v"].tolist() == [7]
+
+
+def test_torn_compressed_frame_enqueues_nothing():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            body = pack_payload({"v": np.arange(8, dtype=np.int32),
+                                 "r": np.zeros(8, np.int32)})
+            frame = pack_frame(wire.DATA_COMPRESSED, 0, body)
+            raw = socket.create_connection(("127.0.0.1", srv.port))
+            raw.sendall(frame[: len(frame) - 9])  # torn mid-payload
+            raw.close()
+            deadline = time.monotonic() + 5
+            while (bus.snapshot()["counters"].get(
+                    "ingest.frames_truncated", 0) < 1
+                    and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert bus.snapshot()["counters"]["ingest.frames_truncated"] == 1
+            assert srv.next_seq == 0
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                cli.send_compressed({"v": np.arange(8, dtype=np.int32),
+                                     "r": np.zeros(8, np.int32)})
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0]
+
+
+def test_duplicate_compressed_replay_dropped_and_reacked():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            p0 = {"v": np.asarray([1], np.int32),
+                  "r": np.asarray([0], np.int32)}
+            cli.send_compressed(p0)
+            cli.flush(timeout=10)
+            # Replay seq 0 raw (a reconnect race): dropped, re-acked.
+            with cli._send_lock:
+                cli._sock.sendall(
+                    pack_frame(wire.DATA_COMPRESSED, 0, pack_payload(p0))
+                )
+            cli.send_compressed({"v": np.asarray([2], np.int32),
+                                 "r": np.asarray([0], np.int32)})
+            cli.flush(timeout=10)
+            cli.close()
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1]
+        assert bus.snapshot()["counters"]["ingest.frames_duplicate"] == 1
+
+
+def test_mixed_stream_consumers_fail_loudly():
+    """A compressed frame reaching a raw-chunk consumer (and vice
+    versa) is a protocol error, not a silent mis-fold."""
+    with obs_bus.scope():
+        with IngestServer(queue_depth=4) as srv:
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                cli.send_compressed({"v": np.asarray([1], np.int32),
+                                     "r": np.asarray([0], np.int32)})
+                cli.flush(timeout=10)
+            with pytest.raises(ValueError, match="compressed DATA frame"):
+                next(srv.chunks(capacity=8))
+        with IngestServer(queue_depth=4) as srv:
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                cli.send(edge_payload([0], [1]))
+                cli.flush(timeout=10)
+            with pytest.raises(ValueError, match="raw DATA frame"):
+                next(srv.compressed_payloads())
+
+
+def test_precompressed_wire_fold_matches_file_ingest():
+    """The wire-vs-file bit-identity twin: a client-compressed stream
+    folded with ``precompressed=True`` emits window-by-window labels
+    identical to the file-ingest codec path over the SAME chunks — and
+    the traced serve side shows ZERO compress spans (the stack stage
+    carries the staging instead)."""
+    from gelly_tpu import obs
+    from gelly_tpu.engine.aggregation import run_aggregation
+    from gelly_tpu.library.connected_components import (
+        connected_components,
+    )
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    n_v = 1 << 10
+    m1 = mesh_lib.make_mesh(1)
+    chunks = _cc_chunks(n_v=n_v, chunk=256, chunks=6)
+    agg_file = connected_components(n_v, codec="sparse")
+    golden = [
+        np.asarray(w) for w in run_aggregation(
+            agg_file, chunks, merge_every=2, mesh=m1, ingest_workers=0,
+            prefetch_depth=0, h2d_depth=0,
+        )
+    ]
+
+    agg_wire = connected_components(n_v, codec="sparse")
+    payloads = [agg_wire.host_compress(c) for c in chunks]
+    tracer = obs.SpanTracer()
+    with obs_bus.scope(), obs.install(tracer):
+        with IngestServer(queue_depth=16, stop_on_bye=True) as srv:
+            def feed():
+                with IngestClient("127.0.0.1", srv.port) as cli:
+                    for p in payloads:
+                        cli.send_compressed(p)
+                    cli.flush(timeout=30)
+            t = threading.Thread(target=feed, daemon=True)
+            t.start()
+            wire_windows = [
+                np.asarray(w) for w in run_aggregation(
+                    agg_wire, srv.compressed_payloads(), merge_every=2,
+                    mesh=m1, precompressed=True, ingest_workers=0,
+                    prefetch_depth=0, h2d_depth=0,
+                )
+            ]
+            t.join(timeout=30)
+    assert len(wire_windows) == len(golden) > 1
+    for i, (w, g) in enumerate(zip(wire_windows, golden)):
+        assert w.tobytes() == g.tobytes(), f"window {i} diverged"
+    # Zero server-side compress spans; the stack stage staged every unit.
+    assert tracer.spans("compress") == []
+    assert len(tracer.spans("stack")) == len(chunks)
+
+
+def test_precompressed_validation():
+    from gelly_tpu.engine.aggregation import run_aggregation
+    from gelly_tpu.library.connected_components import (
+        connected_components,
+    )
+
+    n_v = 1 << 10
+    raw_plan = connected_components(n_v, ingest_combine=False)
+    with pytest.raises(ValueError, match="codec-capable"):
+        run_aggregation(raw_plan, [], precompressed=True).result()
+    # A stack_ordered plan has no producer-compressible wire form
+    # (its host_compress ships raw views; the id session is consumer-
+    # side stream-order state) — refused like the fused/tenant twins.
+    compact = connected_components(n_v, codec="compact",
+                                   compact_capacity=n_v)
+    with pytest.raises(ValueError, match="ordered stacker"):
+        run_aggregation(compact, [], precompressed=True).result()
+    codec_plan = connected_components(n_v, codec="sparse")
+    with pytest.raises(ValueError, match="merge_every-only"):
+        run_aggregation(codec_plan, [], precompressed=True,
+                        window_ms=10).result()
+    with pytest.raises(ValueError, match="host_precombine"):
+        run_aggregation(codec_plan, [], precompressed=True,
+                        host_precombine=lambda c: c).result()
+    class _Provider:  # quacks like a ShardedEdgeSource
+        def stage_units(self, *a, **k):
+            return iter(())
+
+    with pytest.raises(ValueError, match="source_provider parses"):
+        run_aggregation(codec_plan, [], precompressed=True,
+                        source_provider=_Provider()).result()
+    # Out-of-range ids in a producer-compressed payload raise at
+    # staging (payload_to_chunk parity) — never silently clamp in the
+    # device scatter.
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    bad = {"v": np.asarray([n_v + 5], np.int32),
+           "r": np.asarray([0], np.int32)}
+    with pytest.raises(ValueError, match="out of range"):
+        run_aggregation(
+            codec_plan, [bad], precompressed=True,
+            mesh=mesh_lib.make_mesh(1), ingest_workers=0,
+            prefetch_depth=0, h2d_depth=0,
+        ).result()
+
+
+# --------------------------------------------------------------------- #
 # SIGKILL'd server: no double-fold of acked chunks (slow; CI ingest lane)
 
 
@@ -363,12 +613,13 @@ CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "_ingest_crash_child.py")
 
 
-def _spawn_server_child(ckpt, port_file, out, total, sleep_s):
+def _spawn_server_child(ckpt, port_file, out, total, sleep_s,
+                        mode="raw"):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
     return subprocess.Popen(
         [sys.executable, CHILD, str(ckpt), str(port_file), str(out),
-         str(total), str(sleep_s)],
+         str(total), str(sleep_s), mode],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
 
@@ -389,16 +640,31 @@ def _wait_port(port_file, proc, timeout=120):
 
 @pytest.mark.slow
 @pytest.mark.faults
-def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path):
+@pytest.mark.parametrize("mode", ["raw", "compressed"])
+def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path, mode):
+    """``mode="compressed"`` runs the same SIGKILL protocol over
+    CLIENT-COMPRESSED DATA_COMPRESSED frames (sparse CC pairs): acked
+    compressed chunks must never double-fold either — same seq space,
+    same checkpoint-gated ack contract."""
     import _ingest_crash_child as child_mod
 
+    compressed = mode == "compressed"
     rng = np.random.default_rng(23)
     total = 64
-    payloads = [
-        edge_payload(rng.integers(0, child_mod.N_V, 32),
-                     rng.integers(0, child_mod.N_V, 32))
-        for _ in range(total)
-    ]
+
+    def mk_payload():
+        src = rng.integers(0, child_mod.N_V, 32)
+        dst = rng.integers(0, child_mod.N_V, 32)
+        if compressed:
+            from gelly_tpu.library.connected_components import (
+                cc_pairs_numpy,
+            )
+
+            v, r = cc_pairs_numpy(src, dst, None, child_mod.N_V)
+            return {"v": v, "r": r}
+        return edge_payload(src, dst)
+
+    payloads = [mk_payload() for _ in range(total)]
     # Golden: the same fold, in-process, uninterrupted.
     golden = child_mod.init_state()
     for p in payloads:
@@ -408,7 +674,7 @@ def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path):
     port_file = str(tmp_path / "port")
     out = str(tmp_path / "final.npz")
 
-    p1 = _spawn_server_child(ckpt, port_file, out, total, 0.03)
+    p1 = _spawn_server_child(ckpt, port_file, out, total, 0.03, mode)
     port = _wait_port(port_file, p1)
     cli = IngestClient("127.0.0.1", port, send_pause_timeout=60)
     cli.connect()
@@ -422,7 +688,7 @@ def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path):
 
         while sent < total:
             try:
-                cli.send(payloads[sent])
+                cli.send(payloads[sent], compressed=compressed)
                 sent += 1
             except IngestError:
                 # The failed send is already BUFFERED (resend-buffer
@@ -452,7 +718,7 @@ def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path):
     # valid checkpoint; the client reconnects and resends exactly the
     # unacked suffix.
     os.unlink(port_file)
-    p2 = _spawn_server_child(ckpt, port_file, out, total, 0.0)
+    p2 = _spawn_server_child(ckpt, port_file, out, total, 0.0, mode)
     cli.port = _wait_port(port_file, p2)
     deadline = time.monotonic() + 60
     while True:
@@ -466,7 +732,7 @@ def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path):
     assert cli.acked >= acked_before_kill  # acked work never rewinds
 
     while sent < total:  # finish the stream
-        cli.send(payloads[sent])
+        cli.send(payloads[sent], compressed=compressed)
         sent += 1
     cli.flush(timeout=120)
     cli.close()
@@ -477,9 +743,10 @@ def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path):
     final, pos, _ = load_checkpoint(out, like=child_mod.init_state())
     assert pos == total
     # THE exactly-once assertion: counters (non-idempotent) exact.
+    key = "v" if compressed else "src"
     assert int(final["chunks"]) == total
     assert int(final["edges"]) == sum(
-        int(p["src"].shape[0]) for p in payloads
+        int(p[key].shape[0]) for p in payloads
     )
     np.testing.assert_array_equal(child_mod.labels(final),
                                   child_mod.labels(golden))

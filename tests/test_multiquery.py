@@ -215,13 +215,18 @@ def test_fused_through_the_sharded_source_provider(tmp_path):
 
 
 def test_fuse_refuses_stateful_codec_plans():
+    """Only the genuinely unfusable cases stay refused now that plain
+    codec plans ride the shared compress stage — and the message says
+    WHY: the stack_ordered session's id assignment needs global stream
+    order, and a requires_codec plan without an engageable shared
+    codec has no raw fold to fall back to."""
     compact = connected_components(N_V, codec="compact",
                                    compact_capacity=N_V)
-    with pytest.raises(ValueError, match="stateful host codec"):
+    with pytest.raises(ValueError, match="GLOBAL STREAM order"):
         fuse([cc_query(N_V), QuerySpec("compact", compact)])
-    with pytest.raises(ValueError, match="stateful host codec"):
+    with pytest.raises(ValueError, match="stack_ordered"):
         fuse([QuerySpec("ordered", _dummy_agg(stack_ordered=True))])
-    with pytest.raises(ValueError, match="stateful host codec"):
+    with pytest.raises(ValueError, match="raw fold does not exist"):
         fuse([QuerySpec("codec", _dummy_agg(requires_codec=True))])
 
 
@@ -279,6 +284,129 @@ def test_run_aggregation_fused_arg_validation():
 
 
 # --------------------------------------------------------------------- #
+# fused codec sharing (the shared compression plane)
+
+
+def _codec_queries():
+    return [
+        cc_query(N_V, compressed=True, codec="sparse"),
+        degrees_query(N_V, compressed=True, codec="sparse"),
+        bipartiteness_query(N_V, compressed=True, codec="sparse"),
+    ]
+
+
+def _bipartite_adversarial_edges():
+    """The adversarial shapes minus odd cycles/self-loops (hot vertex,
+    even cycle, random even->odd pairs): keeps the bipartiteness
+    labels/colors DEFINED, so raw-vs-codec window comparisons are exact
+    on every leaf (after a conflict the forest internals are
+    implementation-defined — the observable collapses to the ok flag,
+    which the standalone-vs-fused comparison below still covers)."""
+    edges = [(4, 5), (5, 6), (6, 7), (7, 4)]  # even cycle
+    edges += [(0, v) for v in range(21, 44, 2)]  # hot vertex (even->odd)
+    rng = np.random.default_rng(43)
+    a = rng.integers(5, 44, 64) * 2
+    b = rng.integers(5, 44, 64) * 2 + 1
+    edges += [(int(x), int(y)) for x, y in zip(a, b)]
+    return edges
+
+
+def test_fused_codec_one_payload_window_parity():
+    """With every query's codec on, the fused plan compresses each
+    chunk ONCE — one multi-query payload (compress spans == chunks,
+    not chunks x Q; ``multiquery.compressed_chunks`` == chunks) — and
+    the run is window-by-window bit-identical to the raw fused run."""
+    from gelly_tpu import obs
+
+    edges = _bipartite_adversarial_edges()
+    n_chunks = -(-len(edges) // CHUNK)
+    raw = list(run_aggregation(
+        None, _stream(edges),
+        queries=[cc_query(N_V), degrees_query(N_V),
+                 bipartiteness_query(N_V)],
+        merge_every=2, **_kw(),
+    ))
+    tracer = obs.SpanTracer()
+    with obs_bus.scope() as bus, obs.install(tracer):
+        comp = list(run_aggregation(
+            None, _stream(edges), queries=_codec_queries(),
+            merge_every=2, **_kw(),
+        ))
+    assert len(raw) == len(comp) >= 2
+    for i, (a, b) in enumerate(zip(raw, comp)):
+        for name in ("cc", "degrees", "bipartiteness"):
+            _assert_tree_identical(a[name], b[name], f"w{i}/{name}")
+    counters = bus.snapshot()["counters"]
+    assert counters["multiquery.compressed_chunks"] == n_chunks
+    assert len(tracer.spans("compress")) == n_chunks
+    assert len(tracer.spans("fold")) == n_chunks  # still 1/chunk
+
+
+def test_fused_codec_matches_standalone_codec_runs():
+    """Fused-codec vs STANDALONE codec runs on the full adversarial
+    stream: every query's final summary bit-identical — both sides run
+    the same fold_compressed over the same per-query stacked payloads,
+    so even conflict-collapsed forests match exactly."""
+    final = run_aggregation(
+        None, _stream(), queries=_codec_queries(), merge_every=2,
+        **_kw(),
+    ).result()
+    for q in _codec_queries():
+        alone = run_aggregation(
+            q.agg, _stream(), merge_every=2, **_kw()
+        ).result()
+        _assert_tree_identical(alone, final[q.name], q.name)
+
+
+def test_fuse_share_codec_knob():
+    fused = fuse(_codec_queries(), share_codec=True)
+    assert fused.host_compress is not None
+    assert fused.fold_compressed is not None
+    pinned_raw = fuse(_codec_queries(), share_codec=False)
+    assert pinned_raw.host_compress is None
+    # mixed sets (one raw query) fall back to the raw fused fold
+    mixed = fuse([cc_query(N_V, compressed=True, codec="sparse"),
+                  degrees_query(N_V)])
+    assert mixed.host_compress is None
+    # a non-accumulating query keeps the codec off (its masked merge
+    # window fires at chunk grain inside the raw fold)
+    with pytest.raises(ValueError, match="share_codec=True"):
+        fuse([cc_query(N_V, compressed=True, codec="sparse"),
+              spanner_query(N_V, k=2, every=2)], share_codec=True)
+    with pytest.raises(ValueError, match="share_codec"):
+        fuse(_codec_queries(), share_codec="yes")
+
+
+def test_fused_codec_checkpoint_resume_bit_identical(tmp_path):
+    """The codec-path twin of the raw resume test: one position covers
+    every query's leaves + the step counter; a mid-stream resume of
+    the fused-CODEC run finishes bit-identical."""
+    golden = run_aggregation(
+        None, _stream(), queries=_codec_queries(), merge_every=2,
+        **_kw(),
+    ).result()
+    ck = str(tmp_path / "mqc.npz")
+    it = iter(run_aggregation(
+        None, _stream(), queries=_codec_queries(), merge_every=2,
+        checkpoint_path=ck, checkpoint_every=1, **_kw(),
+    ))
+    next(it)
+    next(it)
+    it.close()
+    assert os.path.exists(ck)
+    from gelly_tpu.engine.checkpoint import read_checkpoint_header
+
+    pos = read_checkpoint_header(ck)["position"]
+    assert 0 < pos < len(list(_stream()))
+    resumed = run_aggregation(
+        None, _stream(), queries=_codec_queries(), merge_every=2,
+        checkpoint_path=ck, checkpoint_every=1, resume=True, **_kw(),
+    ).result()
+    for name in ("cc", "degrees", "bipartiteness"):
+        _assert_tree_identical(golden[name], resumed[name], name)
+
+
+# --------------------------------------------------------------------- #
 # exactly-once checkpoint / resume
 
 
@@ -316,9 +444,11 @@ CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "_multiquery_crash_child.py")
 
 
-def _spawn(ckpt, out, sleep_s):
+def _spawn(ckpt, out, sleep_s, compressed=False):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)  # single default CPU device is enough
+    if compressed:
+        env["GELLY_MQ_COMPRESSED"] = "1"
     return subprocess.Popen(
         [sys.executable, CHILD, str(ckpt), str(out), str(sleep_s)],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -327,20 +457,25 @@ def _spawn(ckpt, out, sleep_s):
 
 @pytest.mark.slow
 @pytest.mark.faults
-def test_fused_kill9_resume_bit_identical(tmp_path):
+@pytest.mark.parametrize("compressed", [False, True],
+                         ids=["raw", "codec"])
+def test_fused_kill9_resume_bit_identical(tmp_path, compressed):
     """SIGKILL with units in flight: the resumed fused run's per-query
     emissions are bit-identical to an unkilled run — the one recorded
-    position covers every query at once."""
+    position covers every query at once. The ``codec`` variant runs
+    the fused-CODEC plan (shared compress stage + fold_compressed), so
+    the kill lands with compressed payload units in flight."""
     from gelly_tpu.engine.checkpoint import load_checkpoint
 
     ckpt = tmp_path / "mq-ck.npz"
     out_clean = tmp_path / "clean.npz"
     out_resumed = tmp_path / "resumed.npz"
 
-    p = _spawn(tmp_path / "clean-ck.npz", out_clean, 0.0)
+    p = _spawn(tmp_path / "clean-ck.npz", out_clean, 0.0,
+               compressed=compressed)
     assert p.wait(timeout=300) == 0
 
-    p = _spawn(ckpt, out_resumed, 0.05)
+    p = _spawn(ckpt, out_resumed, 0.05, compressed=compressed)
     deadline = time.monotonic() + 300
     while time.monotonic() < deadline:
         if p.poll() is not None:
@@ -360,7 +495,7 @@ def test_fused_kill9_resume_bit_identical(tmp_path):
     total = -(-child.N_EDGES // child.CHUNK)
     assert 0 < pos < total  # mid-stream position
 
-    p = _spawn(ckpt, out_resumed, 0.0)
+    p = _spawn(ckpt, out_resumed, 0.0, compressed=compressed)
     assert p.wait(timeout=300) == 0
     resumed, _, _ = load_checkpoint(str(out_resumed))
     clean, _, _ = load_checkpoint(str(out_clean))

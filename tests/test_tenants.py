@@ -190,12 +190,24 @@ def test_mesh_shards_the_tenant_axis():
 
 
 def test_tier_refuses_stateful_codec_plans():
+    """Only the genuinely un-batchable case stays refused now that
+    codec plans compile a vmapped fold_codec — and the message names
+    the reason: the stack_ordered session assigns compact ids in
+    global stream order, which concurrent lanes cannot provide."""
     from gelly_tpu.engine.aggregation import _compiled_tenant_plan
 
     compact = connected_components(N_V, codec="compact",
                                    compact_capacity=N_V)
-    with pytest.raises(ValueError, match="stateful host codec"):
+    with pytest.raises(ValueError, match="GLOBAL STREAM order"):
         _compiled_tenant_plan(compact, 2)
+    # A codec-ONLY plan on a raw tier is refused up front too (its raw
+    # fold does not exist), pointing at the compressed-tier knob.
+    with pytest.raises(ValueError, match="compressed=True"):
+        TenantBatch(compact, CHUNK)
+    # Plain codec plans now compile fold_codec next to the raw fold.
+    sparse = connected_components(N_V, codec="sparse")
+    plan = _compiled_tenant_plan(sparse, 2)
+    assert plan.fold_codec is not None
 
 
 def test_tier_refuses_host_transforms():
@@ -527,9 +539,11 @@ CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "_tenants_crash_child.py")
 
 
-def _spawn(ckpt_dir, out, sleep_s):
+def _spawn(ckpt_dir, out, sleep_s, compressed=False):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)  # single default CPU device is enough
+    if compressed:
+        env["GELLY_TEN_COMPRESSED"] = "1"
     return subprocess.Popen(
         [sys.executable, CHILD, str(ckpt_dir), str(out), str(sleep_s)],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -537,10 +551,15 @@ def _spawn(ckpt_dir, out, sleep_s):
 
 
 @pytest.mark.faults
-def test_multi_tenant_kill9_resume_bit_identical(tmp_path):
+@pytest.mark.parametrize("compressed", [False, True],
+                         ids=["raw", "codec"])
+def test_multi_tenant_kill9_resume_bit_identical(tmp_path, compressed):
     """SIGKILL a multi-tenant run mid-window; the resumed incarnation's
     final forest must be bit-identical, per tenant, to the unkilled
-    run AND to each tenant's single-stream run_aggregation oracle."""
+    run AND to each tenant's single-stream run_aggregation oracle. The
+    ``codec`` variant runs a COMPRESSED tier (producer-side compress +
+    fold_codec lanes): the per-tenant payload-position resume must be
+    exactly-once too."""
     import _tenants_crash_child as child
 
     out_clean = tmp_path / "clean.npz"
@@ -548,10 +567,10 @@ def test_multi_tenant_kill9_resume_bit_identical(tmp_path):
     ckpt_clean = tmp_path / "ck-clean"
     ckpt = tmp_path / "ck"
 
-    p = _spawn(ckpt_clean, out_clean, 0.0)
+    p = _spawn(ckpt_clean, out_clean, 0.0, compressed=compressed)
     assert p.wait(timeout=300) == 0
 
-    p = _spawn(ckpt, out_resumed, 0.03)
+    p = _spawn(ckpt, out_resumed, 0.03, compressed=compressed)
     deadline = time.monotonic() + 300
     while time.monotonic() < deadline:
         if p.poll() is not None:
@@ -576,14 +595,15 @@ def test_multi_tenant_kill9_resume_bit_identical(tmp_path):
         _, pos, _ = load_checkpoint(str(newest))
         assert 0 < pos < total  # killed mid-stream for every tenant
 
-    p = _spawn(ckpt, out_resumed, 0.0)
+    p = _spawn(ckpt, out_resumed, 0.0, compressed=compressed)
     assert p.wait(timeout=300) == 0
     resumed, _, _ = load_checkpoint(str(out_resumed))
     clean, _, _ = load_checkpoint(str(out_clean))
     assert len(resumed) == len(clean) == child.TENANTS
     for t in range(child.TENANTS):
         assert resumed[t].tobytes() == clean[t].tobytes()
-        # The unkilled single-stream oracle.
+        # The unkilled single-stream oracle (always the RAW plan: the
+        # compressed tier's labels must match it bit-for-bit anyway).
         agg, _cap = cc_tenant_tier(child.N_V, chunk_capacity=child.CHUNK)
         want = np.asarray(
             child.build_stream(t).aggregate(agg, merge_every=2).result()
@@ -633,6 +653,209 @@ def test_heartbeat_carries_tenant_fields():
     assert folds and all(
         s["args"]["lanes"] >= s["args"]["advanced"] for s in folds
     )
+
+
+# --------------------------------------------------------------------- #
+# compressed tiers (the shared compression plane's tenant leg)
+
+
+def _compressed_tier():
+    return cc_tenant_tier(N_V, chunk_capacity=CHUNK, compressed=True,
+                          codec="sparse")
+
+
+def test_compressed_tier_bit_identical_to_raw_tier():
+    """Tenants shipping producer-compressed payloads fold through the
+    vmapped fold_codec and every final snapshot is bit-identical to
+    the raw tier's (and to the single-stream oracle); dispatches land
+    on ``tenants.compressed_dispatches``."""
+    def chunk_lists(t):
+        return list(_stream(300 + t))
+
+    agg_r, cap = cc_tenant_tier(N_V, chunk_capacity=CHUNK)
+    eng_r = MultiTenantEngine(merge_every=2)
+    eng_r.add_tier("cc", agg_r, cap)
+    for t in range(4):
+        eng_r.admit(t, "cc", chunks=chunk_lists(t))
+    raw = eng_r.drain()
+
+    agg_c, cap = _compressed_tier()
+    eng_c = MultiTenantEngine(merge_every=2)
+    eng_c.add_tier("cc", agg_c, cap, compressed=True)
+    with obs_bus.scope() as bus:
+        for t in range(4):
+            eng_c.admit(t, "cc", chunks=[
+                agg_c.host_compress(c) for c in chunk_lists(t)
+            ])
+        comp = eng_c.drain()
+    counters = bus.snapshot()["counters"]
+    assert counters["tenants.compressed_dispatches"] >= 1
+    assert counters["tenants.compressed_dispatches"] == \
+        counters["tenants.dispatches"]
+    for t in range(4):
+        assert comp[t].dtype == raw[t].dtype
+        assert comp[t].tobytes() == raw[t].tobytes()
+
+
+def test_compressed_tier_push_mode_and_uneven_streams():
+    """submit_payload from the producer thread; uneven backlogs ride
+    masked identity-payload lanes without disturbing neighbors."""
+    agg, cap = _compressed_tier()
+    eng = MultiTenantEngine(merge_every=1)
+    eng.add_tier("cc", agg, cap, compressed=True)
+    chunks_a = list(_stream(31, n_edges=4 * CHUNK))
+    chunks_b = list(_stream(32, n_edges=CHUNK))  # 4x shorter
+    eng.admit("a", "cc")
+    eng.admit("b", "cc")
+    for c in chunks_a:
+        eng.submit_payload("a", agg.host_compress(c))
+    for c in chunks_b:
+        eng.submit_payload("b", agg.host_compress(c))
+    eng.finish("a")
+    eng.finish("b")
+    out = eng.drain()
+    for tid, chunks in (("a", chunks_a), ("b", chunks_b)):
+        want = np.asarray(run_aggregation_oracle(chunks))
+        assert out[tid].tobytes() == want.tobytes()
+
+
+def run_aggregation_oracle(chunks):
+    from gelly_tpu.engine.aggregation import run_aggregation
+
+    agg = _cc_plan()
+    return run_aggregation(
+        agg, chunks, merge_every=1, ingest_workers=0,
+        prefetch_depth=0, h2d_depth=0,
+    ).result()
+
+
+def test_compressed_tier_guards():
+    agg_c, cap = _compressed_tier()
+    eng = MultiTenantEngine(merge_every=1)
+    eng.add_tier("cc", agg_c, cap, compressed=True)
+    eng.add_tier("raw", _cc_plan(), cap)
+    eng.admit("c", "cc")
+    eng.admit("r", "raw")
+    chunk = next(iter(_stream(5)))
+    # raw chunk into a compressed tier / payload into a raw tier
+    with pytest.raises(ValueError, match="compressed tier"):
+        eng.submit("c", chunk)
+    with pytest.raises(ValueError, match="raw tier"):
+        eng.submit_payload("r", agg_c.host_compress(chunk))
+    # an EdgeChunk smuggled through submit_payload is named loudly
+    with pytest.raises(ValueError, match="EdgeChunk"):
+        eng.submit_payload("c", chunk)
+    # payload template mismatch raises to the SUBMITTER, not the
+    # scheduler: first payload pins the codec shape
+    eng.submit_payload("c", agg_c.host_compress(chunk))
+    with pytest.raises(ValueError, match="tier template"):
+        eng.submit_payload("c", {"v": np.zeros(3, np.int64),
+                                 "r": np.zeros(3, np.int32)})
+    # a NESTED payload (e.g. a fused multi-query codec dict) must fail
+    # at the submitter, not poison the template as a 0-d object array
+    with pytest.raises(ValueError, match="FLAT dict"):
+        eng.submit_payload("c", {"cc": {"v": np.zeros(2, np.int32)}})
+    # out-of-range ids raise at the submitter (payload_to_chunk
+    # parity) — on device they would silently drop/clamp
+    with pytest.raises(ValueError, match="out of range"):
+        eng.submit_payload("c", {"v": np.asarray([N_V + 3], np.int32),
+                                 "r": np.asarray([0], np.int32)})
+    # one tenant's oversized payload must not inflate every lane's
+    # padded bucket: variable keys are bounded by 2 x chunk_capacity
+    big = np.arange(2 * CHUNK + 1, dtype=np.int32) % N_V
+    with pytest.raises(ValueError, match="tier bound"):
+        eng.submit_payload("c", {"v": big, "r": np.zeros_like(big)})
+    # a compressed tier needs a plan with fold_compressed
+    with pytest.raises(ValueError, match="fold_compressed"):
+        eng.add_tier("bad", _cc_plan(), cap, compressed=True)
+    # ... and host_compress (masked lanes pad with the codec identity
+    # payload — a missing one must fail at REGISTRATION, not at the
+    # first dispatch with a drained lane)
+    import dataclasses
+
+    no_hc = dataclasses.replace(
+        agg_c, host_compress=None, name="no-host-compress",
+    )
+    with pytest.raises(ValueError, match="host_compress"):
+        eng.add_tier("bad2", no_hc, cap, compressed=True)
+
+
+@pytest.mark.ingest
+def test_tenant_router_routes_compressed_streams():
+    """Wire leg end to end: clients compress BEFORE send
+    (DATA_COMPRESSED + tenant tag), the router submits payloads
+    straight into the compressed tier, and the folded labels match the
+    single-stream oracle — with zero ingest-side compress work."""
+    from gelly_tpu.ingest import IngestClient, IngestServer, TenantRouter
+
+    agg, cap = cc_tenant_tier(N_V, chunk_capacity=16, compressed=True,
+                              codec="sparse")
+    eng = MultiTenantEngine(merge_every=1).start()
+    router = TenantRouter(eng, "small", vertex_capacity=N_V)
+    eng.add_tier("small", agg, cap, compressed=True)
+    edges = {
+        t: np.random.default_rng(200 + t).integers(0, N_V, (64, 2))
+        for t in (3, 4)
+    }
+    from gelly_tpu.core.chunk import make_chunk
+
+    def payloads_for(t):
+        out = []
+        for i in range(0, 64, 16):
+            s = edges[t][i:i + 16, 0].astype(np.int64)
+            d = edges[t][i:i + 16, 1].astype(np.int64)
+            c = make_chunk(s.astype(np.int32), d.astype(np.int32),
+                           raw_src=s, raw_dst=d, capacity=16,
+                           device=False)
+            p = dict(agg.host_compress(c))
+            p["tenant"] = np.array([t], np.int64)
+            out.append(p)
+        return out
+
+    servers, clients = [], []
+    try:
+        for t in (3, 4):
+            s = IngestServer(port=0).start()
+            router.attach(s)
+            c = IngestClient("127.0.0.1", s.port).connect()
+            servers.append(s)
+            clients.append((t, c))
+        for t, c in clients:
+            for p in payloads_for(t):
+                c.send_compressed(p)
+            c.flush()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if (eng.queue_depth() == 0
+                        and eng.position(3) >= 4
+                        and eng.position(4) >= 4):
+                    break
+            except KeyError:
+                pass  # auto-admission not seen yet
+            time.sleep(0.05)
+        for t in (3, 4):
+            eng.finish(t)
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+            eng.snapshot_window(t) == 0 for t in (3, 4)
+        ):
+            time.sleep(0.05)
+        got = {t: eng.labels(t) for t in (3, 4)}
+    finally:
+        eng.stop()
+        for s in servers:
+            s.stop()
+        router.stop()
+    raw_plan = _cc_plan()
+    for t in (3, 4):
+        st = edge_stream_from_edges(
+            [(int(a), int(b)) for a, b in edges[t]],
+            vertex_capacity=N_V, chunk_size=16,
+            table=IdentityVertexTable(N_V),
+        )
+        want = np.asarray(st.aggregate(raw_plan, merge_every=1).result())
+        assert got[t].tobytes() == want.tobytes()
 
 
 # --------------------------------------------------------------------- #
