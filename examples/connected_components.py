@@ -4,9 +4,17 @@ Usage: python examples/connected_components.py [--checkpoint-dir=DIR]
            [--codec-workers=K] [--h2d-depth=D] [--merge-mode=MODE]
            [--trace-out=PATH] [--shards=S]
            [--queries=cc,degrees,bipartiteness]
-           [--serve=PORT | --connect=HOST:PORT] [--compressed]
+           [--serve=PORT | --connect=HOST:PORT] [--compressed] [--stats]
            [<edges path> <merge every chunks>]
 Prints (vertex, component) pairs after each merge window.
+
+``--stats`` (with ``--serve``) turns on serving-plane telemetry
+recording (``gelly_tpu.obs``): fold-dispatch / checkpoint-write /
+receive→stage latency histograms and the end-to-end backlog-age
+watermark populate, and a live ``python -m gelly_tpu.obs.status
+HOST:PORT`` (or any STATS wire frame) answers mid-stream with the JSON
+snapshot — without perturbing the DATA stream (README
+"Observability").
 
 ``--compressed`` (with ``--serve``/``--connect``) switches the wire to
 client-side-compressed DATA_COMPRESSED frames: the connect peer runs
@@ -272,6 +280,7 @@ def main(args):
     connect = None
     queries = None
     compressed = False
+    stats = False
     rest = []
     for a in args:
         if a.startswith("--checkpoint-dir="):
@@ -294,6 +303,8 @@ def main(args):
             connect = a.split("=", 1)[1]
         elif a == "--compressed":
             compressed = True
+        elif a == "--stats":
+            stats = True
         else:
             rest.append(a)
     if ckpt_dir is not None and (
@@ -315,6 +326,22 @@ def main(args):
             "in DATA_COMPRESSED frames); pair it with --serve or "
             "--connect"
         )
+    if stats and serve is None:
+        raise SystemExit(
+            "--stats enables serving-plane telemetry on the ingest "
+            "SERVER (histograms + watermarks behind the STATS frame); "
+            "pair it with --serve"
+        )
+    if stats:
+        # Recording stays on for the process lifetime: every STATS
+        # request (python -m gelly_tpu.obs.status HOST:PORT) reads the
+        # live histograms/watermarks mid-stream.
+        from gelly_tpu import obs
+
+        obs.set_recording(True)
+        print("# serving-plane telemetry recording ON — query live "
+              "stats with: python -m gelly_tpu.obs.status "
+              f"127.0.0.1:{serve}")
     if connect is not None:
         return _connect_main(connect, rest, compressed=compressed)
     if serve is not None and (ckpt_dir is not None or shards is not None):

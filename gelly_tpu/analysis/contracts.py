@@ -92,19 +92,23 @@ dead docs. OB003 is glossary-free — the collision is a property of the
 call sites alone):
 
 - ``OB001`` undocumented name: every string-literal name passed to a
-  bus ``inc``/``gauge``/``emit`` anywhere in the linted set must
-  appear in the glossary. Prefixed f-string names
-  (``f"{prefix}.checkpoints"``) are matched as ``*.suffix`` wildcards
-  — documented when any glossary entry ends with the suffix, flagged
-  when none does; fully dynamic names are skipped (documented
-  limitation).
+  bus ``inc``/``gauge``/``emit``/``observe`` anywhere in the linted
+  set must appear in the glossary (histograms — ``observe`` sites —
+  have their own glossary section in ``obs/bus.py``, covered by the
+  same rule). Prefixed f-string names (``f"{prefix}.checkpoints"``)
+  are matched as ``*.suffix`` wildcards — documented when any glossary
+  entry ends with the suffix, flagged when none does; fully dynamic
+  names are skipped (documented limitation).
 - ``OB002`` dead glossary entry: a documented name no call site emits
-  (exact or wildcard) — stale docs that misdirect an operator mid-
-  incident. Anchored at the glossary line in ``bus.py``.
-- ``OB003`` counter/gauge collision: one name published through both
-  ``inc``/``emit`` and ``gauge`` — exporters and dashboards treat the
-  two as different metric types, so the collision silently shadows one
-  of them.
+  (exact or wildcard, histograms included) — stale docs that misdirect
+  an operator mid-incident. Anchored at the glossary line in
+  ``bus.py``.
+- ``OB003`` metric-kind collision: one name published through more
+  than one of counter (``inc``/``emit``), gauge (``gauge``) and
+  histogram (``observe``) — exporters and dashboards treat the kinds
+  as different metric types, so a collision silently shadows one of
+  them. Flagged at every site except the lowest-precedence kind's
+  (counter < gauge < histogram).
 
 Findings carry ``path:line`` anchors and render like every other
 analysis finding; the CLI exit code is non-zero iff any unsuppressed
@@ -189,10 +193,11 @@ RULES: dict[str, tuple[str, str]] = {
         "entry or re-point it at the name the code actually publishes",
     ),
     "OB003": (
-        "one name used as both counter and gauge",
-        "exporters treat counters and gauges as different metric types "
-        "— publishing one name through both inc/emit and gauge() "
-        "silently shadows one of them; split the names",
+        "one name published under more than one metric kind",
+        "exporters treat counters, gauges and histograms as different "
+        "metric types — publishing one name through more than one of "
+        "inc/emit, gauge() and observe() silently shadows one of them; "
+        "split the names",
     ),
 }
 
@@ -226,7 +231,12 @@ _ACK_BOUND = re.compile(r"acked|server_next|upto|(^|[^a-z])seq$")
 # OB: a glossary table row — a DOTTED ``subsystem.name`` at column 0 of
 # the bus module (prose backtick spans are mid-line or undotted).
 _GLOSSARY_RE = re.compile(r"^``([a-z_][a-z0-9_]*(?:\.[a-z0-9_]+)+)``")
-_BUS_METHODS = {"inc": "counter", "emit": "counter", "gauge": "gauge"}
+_BUS_METHODS = {"inc": "counter", "emit": "counter", "gauge": "gauge",
+                "observe": "histogram"}
+# OB003: when one name is published through several kinds, the sites of
+# every kind except the LOWEST-precedence one are flagged (deterministic
+# single-side anchoring, so the tip never double-reports a collision).
+_KIND_ORDER = {"counter": 0, "gauge": 1, "histogram": 2}
 
 
 @dataclasses.dataclass
@@ -907,11 +917,22 @@ class ContractChecker:
             if not s.wildcard:
                 kinds.setdefault(s.name, set()).add(s.kind)
         for s in self._emits:
-            if (not s.wildcard and s.kind == "gauge"
-                    and kinds.get(s.name) == {"counter", "gauge"}):
+            if s.wildcard:
+                continue
+            seen = kinds.get(s.name, set())
+            if len(seen) < 2:
+                continue
+            # Anchor at every site except the lowest-precedence kind's
+            # (counter < gauge < histogram): a counter+histogram clash
+            # flags the observe() sites, counter+gauge the gauge()
+            # sites — one deterministic side per collision.
+            lowest = min(seen, key=_KIND_ORDER.__getitem__)
+            if s.kind != lowest:
+                others = ", ".join(sorted(seen - {s.kind}))
                 self._emit(
                     s.module, s.node, "OB003",
-                    f"{s.name!r} is gauged here and counted elsewhere",
+                    f"{s.name!r} is published as a {s.kind} here and "
+                    f"as a {others} elsewhere",
                 )
 
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from functools import partial
 from typing import Any, Callable, Iterator, NamedTuple
 
@@ -1238,6 +1239,37 @@ def run_aggregation(
         # always on; it is only touched at unit/window cadence.
         tracer = obs_tracing.active_tracer()
         bus = obs_bus.get_bus()
+        # Serving-plane telemetry (histograms + e2e watermarks), bound
+        # ONCE per run under the same zero-cost-when-disabled contract
+        # as the tracer: `telemetry` is False (and `wm` is None) unless
+        # a tracer is installed or obs.bus.recording() is on, and every
+        # recording site below is guarded by it — the disabled unit
+        # path performs no histogram work, not even a clock read.
+        telemetry = obs_bus.telemetry_on()
+        wm = bus.watermarks if telemetry else None
+        # Sharded-provider unit seqs are lane-interleaved
+        # (``local_unit * shards + shard``, resume offset baked into the
+        # lane starts — readers.stage_units), so ``skip_until + seq *
+        # batch`` does NOT map onto consumption positions there: stamps
+        # would land above the positions retire_fold/retire_durable ever
+        # reach and read as permanent backlog. Provider-path stamps draw
+        # dense positions from this allocator instead (staging order ≈
+        # consumption order within the prefetch depth; every allocated
+        # position is < total chunks, so all stamps retire).
+        wm_alloc = None
+        if wm is not None and source_provider is not None:
+            _wm_lock = threading.Lock()
+            _wm_next = [0]
+
+            def wm_alloc() -> int:
+                # skip_until is read at call time: it is final (resume
+                # position loaded) before any unit is staged.
+                with _wm_lock:
+                    pos = skip_until + _wm_next[0]
+                    _wm_next[0] += 1
+                    return pos
+
+        staged_hw = 0  # staged-depth high-water since the last beat
         # Per-query span attribution for fused plans: every fold span
         # names the queries riding the dispatch (the MultiQueryStream
         # wrapper adds the per-query window tracks).
@@ -1326,6 +1358,26 @@ def run_aggregation(
                         "closed_upto": side_meta["closed_upto"],
                         "max_ts": side_meta["max_ts"],
                     }
+
+        if wm is not None:
+            # (Re)seed the e2e ledger at the exactly-once resume point:
+            # after a crash the low watermark re-seeds from the RESUMED
+            # POSITION's re-read time — never the wall clock, so
+            # backlog age cannot time-travel across a SIGKILL.
+            wm.seed("stream", skip_until)
+
+        def publish_watermarks():
+            # Backlog-age low watermark after a window close / durable
+            # point. Without a checkpoint path the window close IS the
+            # run's retirement point (there is no later durability),
+            # so the ledger drains there.
+            if wm is None:
+                return
+            if not checkpoint_path:
+                wm.retire_durable("stream", chunks_consumed, bus=bus,
+                                  prefix="engine")
+            bus.gauge("engine.backlog_age_s",
+                      round(wm.backlog_age("stream"), 6))
 
         def close_window():
             nonlocal locals_, global_summary, windows_closed, dirty
@@ -1447,6 +1499,7 @@ def run_aggregation(
                         "max_ts": st["max_ts"],
                     },
                 )
+            t_wall = time.perf_counter()
             save_checkpoint(
                 checkpoint_path, snap, position=chunks_consumed,
                 meta={
@@ -1456,7 +1509,16 @@ def run_aggregation(
                 },
             )
             ck_bytes = obs_bus.publish_checkpoint(bus, "engine",
-                                                  checkpoint_path)
+                                                  checkpoint_path,
+                                                  t0=t_wall)
+            if wm is not None:
+                # The durability point: every position the checkpoint
+                # covers retires from the e2e ledger (ingress→durable
+                # histogram) and the low watermark advances.
+                wm.retire_durable("stream", chunks_consumed, bus=bus,
+                                  prefix="engine")
+                bus.gauge("engine.backlog_age_s",
+                          round(wm.backlog_age("stream"), 6))
             if tracer is not None:
                 tracer.span("checkpoint", "checkpoint", t_ck,
                             position=chunks_consumed,
@@ -1501,6 +1563,8 @@ def run_aggregation(
                 stats["chunks"] = chunks_consumed
                 if chunks_consumed <= skip_until:
                     continue
+                if wm is not None:
+                    wm.stamp("stream", chunks_consumed - 1)
                 yield chunk
 
         def produced_units():
@@ -1575,6 +1639,23 @@ def run_aggregation(
             # the H2D span (buffer slot) and the fold span all carry it,
             # so a stalled chunk is attributable end to end.
             seq, group = unit
+            if wm is not None:
+                # Ingress stamp at reader parse/staging time (both the
+                # single-iterator and sharded-provider paths stage
+                # through here). First-stamp-wins: a wire-receive stamp
+                # for the same position is never overwritten. On the
+                # single-iterator path unit seq × batch maps exactly
+                # onto the exactly-once chunk positions the
+                # fold/checkpoint will retire; provider seqs are
+                # lane-interleaved, so their positions come from the
+                # dense wm_alloc counter instead (see its definition).
+                if wm_alloc is not None:
+                    for _ in range(len(group)):
+                        wm.stamp("stream", wm_alloc())
+                else:
+                    base = skip_until + seq * batch
+                    for j in range(len(group)):
+                        wm.stamp("stream", base + j)
             try:
                 faults_mod.inject("codec")
                 t0 = tracer.now() if tracer is not None else 0.0
@@ -1722,10 +1803,17 @@ def run_aggregation(
                 ):
                     if kind == "close":
                         t_merge = tracer.now() if tracer is not None else 0.0
+                        t_h = time.perf_counter() if telemetry else 0.0
                         out = close_window()
+                        if telemetry:
+                            bus.observe("engine.merge_emit_ms",
+                                        (time.perf_counter() - t_h) * 1e3)
+                            wm.retire_fold("stream", chunks_consumed,
+                                           bus=bus, prefix="engine")
                         if tracer is not None:
                             tracer.span("merge_emit", "merge_emit", t_merge,
                                         window=windows_closed)
+                        publish_watermarks()
                         yield out
                     elif use_codec:
                         # The chunk is masked to window ``w``: compress it and
@@ -1780,8 +1868,12 @@ def run_aggregation(
                             tracer.span("h2d", "h2d/slot0", t0, unit=wm_unit,
                                         slot=0)
                             t0 = tracer.now()
+                        t_h = time.perf_counter() if telemetry else 0.0
                         with timer("fold_dispatch"):
                             locals_ = fold_codec(locals_, dev)
+                        if telemetry:
+                            bus.observe("engine.fold_dispatch_ms",
+                                        (time.perf_counter() - t_h) * 1e3)
                         if tracer is not None:
                             tracer.span("fold", "fold", t0, unit=wm_unit,
                                         window=int(w))
@@ -1790,7 +1882,11 @@ def run_aggregation(
                     else:
                         current_window = w
                         t0 = tracer.now() if tracer is not None else 0.0
+                        t_h = time.perf_counter() if telemetry else 0.0
                         locals_ = fold_step(locals_, chunk)
+                        if telemetry:
+                            bus.observe("engine.fold_dispatch_ms",
+                                        (time.perf_counter() - t_h) * 1e3)
                         if tracer is not None:
                             tracer.span("fold", "fold", t0, unit=wm_unit,
                                         window=int(w))
@@ -1862,10 +1958,18 @@ def run_aggregation(
                     chunks_consumed += k
                     stats["chunks"] = chunks_consumed
                     t_fold = tracer.now() if tracer is not None else 0.0
+                    t_h = time.perf_counter() if telemetry else 0.0
                     with timer("fold_dispatch"):
                         locals_ = fold_unit(locals_, unit)
                     bus.inc("engine.units_folded")
                     bus.inc("engine.chunks_folded", k)
+                    if telemetry:
+                        bus.observe("engine.fold_dispatch_ms",
+                                    (time.perf_counter() - t_h) * 1e3)
+                        staged_hw = max(staged_hw, bus.gauges.get(
+                            "pipeline.staged_depth", 0))
+                        wm.retire_fold("stream", chunks_consumed,
+                                       bus=bus, prefix="engine")
                     if tracer is not None:
                         tracer.span("fold", "fold", t_fold, unit=seq,
                                     chunks=k, edges=edges, **fold_attrs)
@@ -1884,32 +1988,52 @@ def run_aggregation(
                                     "pipeline.staged_depth", 0),
                                 h2d_depth=bus.gauges.get(
                                     "pipeline.h2d_depth", 0),
+                                # The serving-plane signals: staged
+                                # high-water since the last beat, p99
+                                # fold dispatch, worst backlog age.
+                                staged_hw=staged_hw,
+                                fold_p99_ms=round(bus.quantile(
+                                    "engine.fold_dispatch_ms", 0.99), 3),
+                                backlog_age_max_s=round(
+                                    bus.watermarks.max_backlog_age(), 3),
                             )
+                            staged_hw = 0
                     chunks_in_window += k
                     dirty = True
                     if chunks_in_window >= merge_every:
                         t_merge = (tracer.now() if tracer is not None
                                    else 0.0)
+                        t_h = (time.perf_counter() if telemetry
+                               else 0.0)
                         with timer("merge_emit"):
                             out = close_window()
                             # The window's ONE completion barrier: the
                             # emission (and with it every fold of the
                             # window) is ready before it is yielded.
                             jax.block_until_ready(out)
+                        if telemetry:
+                            bus.observe("engine.merge_emit_ms",
+                                        (time.perf_counter() - t_h) * 1e3)
                         if tracer is not None:
                             tracer.span("merge_emit", "merge_emit",
                                         t_merge, window=windows_closed)
                         chunks_in_window = 0
+                        publish_watermarks()
                         yield out
                     maybe_checkpoint()
                 if dirty:
                     t_merge = tracer.now() if tracer is not None else 0.0
+                    t_h = time.perf_counter() if telemetry else 0.0
                     with timer("merge_emit"):
                         out = close_window()
                         jax.block_until_ready(out)
+                    if telemetry:
+                        bus.observe("engine.merge_emit_ms",
+                                    (time.perf_counter() - t_h) * 1e3)
                     if tracer is not None:
                         tracer.span("merge_emit", "merge_emit", t_merge,
                                     window=windows_closed, final=True)
+                    publish_watermarks()
                     yield out
                     maybe_checkpoint(force=True)
             finally:

@@ -508,20 +508,33 @@ class MultiQueryStream(SummaryStream):
     def _gen(self):
         bus = obs_bus.get_bus()
         tracer = obs_tracing.active_tracer()
+        # Serving-plane telemetry guard, bound once per run (the same
+        # discipline as the executor's): multiquery.emit_ms measures
+        # the emission SNAPSHOT PUBLICATION — lock wait + reference
+        # swap, the latency live snapshot() readers can induce on the
+        # stream (the window's compute wall is engine.merge_emit_ms,
+        # recorded inside the inner executor).
+        telemetry = obs_bus.telemetry_on()
         names = self.plan.query_names
         bus.gauge("multiquery.fused_queries", len(names))
         bus.inc("multiquery.runs")
         it = iter(self._inner)
+        import time as _time
+
         while True:
             t0 = tracer.now() if tracer is not None else 0.0
             try:
                 out = next(it)
             except StopIteration:
                 return
+            t_h = _time.perf_counter() if telemetry else 0.0
             with self._lock:
                 self._latest = out
                 self._window += 1
                 w = self._window
+            if telemetry:
+                bus.observe("multiquery.emit_ms",
+                            (_time.perf_counter() - t_h) * 1e3)
             bus.inc("multiquery.emissions", len(names))
             if tracer is not None:
                 # Per-query attribution: one span per query per window

@@ -725,6 +725,14 @@ class ResilientRunner:
         start = self.position
         last_ckpt_pos = start
         last_ckpt_time = cfg.clock()
+        # Serving-plane telemetry (same zero-cost-when-disabled guard as
+        # the engine executor): ingress stamps ride the runner's
+        # exactly-once positions, so the resilient driver reports the
+        # same e2e watermarks/histograms as the pipelined path.
+        wm_bus = obs_bus.get_bus()
+        wm = wm_bus.watermarks if obs_bus.telemetry_on() else None
+        if wm is not None:
+            wm.seed("stream", start)
 
         def should_restart(exc: BaseException) -> bool:
             ok = default_retryable(exc)
@@ -755,6 +763,8 @@ class ResilientRunner:
         barrier: tuple[int, int] | None = None  # (epoch, agreed position)
         try:
             for chunk in chunk_iter:
+                if wm is not None:
+                    wm.stamp("stream", self.position)
                 if self._stage is not None:
                     chunk = self._guard(
                         "h2d", lambda c=chunk: self._stage(c)
@@ -767,6 +777,9 @@ class ResilientRunner:
                 self._native_failures = 0
                 self.state = state
                 self.position += 1
+                if wm is not None:
+                    wm.retire_fold("stream", self.position, bus=wm_bus,
+                                   prefix="resilience")
                 self.stats["chunks"] = self.position - start
                 if emission is not None:
                     yield self.position, emission
@@ -837,6 +850,12 @@ class ResilientRunner:
                     state = self._checkpoint(state, final=True)
                     self.state = state
                 self.manager.close()
+            elif wm is not None:
+                # No durability point configured: end-of-stream is the
+                # retirement point — drain the ledger so the watermark
+                # never reads a completed run as backlog.
+                wm.retire_durable("stream", self.position, bus=wm_bus,
+                                  prefix="resilience")
         except BaseException:
             # Leave the newest durable checkpoint in place for the next
             # incarnation; just stop the writer cleanly.
@@ -894,7 +913,22 @@ class ResilientRunner:
             )
             return state
         self.stats["checkpoints"] += 1
+        self._retire_durable()
         return state
+
+    def _retire_durable(self) -> None:
+        """Durability point: the e2e ledger retires every position the
+        just-published snapshot covers and the low watermark advances.
+        (Async writers retire at save() return — the write is in
+        flight; the bus's completed-write counters stay the durability
+        authority.)"""
+        if not obs_bus.telemetry_on():
+            return
+        b = obs_bus.get_bus()
+        b.watermarks.retire_durable("stream", self.position, bus=b,
+                                    prefix="resilience")
+        b.gauge("engine.backlog_age_s",
+                round(b.watermarks.backlog_age("stream"), 6))
 
     def _checkpoint_coordinated(self, state, epoch: int, agreed: int,
                                 final: bool = False):
@@ -920,6 +954,12 @@ class ResilientRunner:
             "barrier",
         )
         self.stats["checkpoints"] += 1
+        # Same durability point as the local path: a committed barrier
+        # epoch retires this host's ledger up to the agreed position —
+        # without it a coordinated run's stamps accumulate forever and
+        # backlog_age reads a healthy multi-host stream as unbounded
+        # backlog.
+        self._retire_durable()
         return state
 
     def run(self):

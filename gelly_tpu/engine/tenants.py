@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time as _time
 from collections import deque
 from typing import Any, Iterator
 
@@ -79,6 +80,12 @@ from ..obs import tracing as obs_tracing
 from .aggregation import SummaryAggregation, _compiled_tenant_plan
 
 logger = logging.getLogger("gelly_tpu.tenants")
+
+
+# The serving-plane telemetry guard (histograms + e2e watermarks) —
+# the ONE shared definition in obs.bus; callers bind the result and
+# never touch the bus histograms otherwise.
+_telemetry_on = obs_bus.telemetry_on
 
 
 def tenant_prefix(tenant_id) -> str:
@@ -466,8 +473,9 @@ class _Tenant:
     thread and submitters/queriers are guarded by the engine lock."""
 
     __slots__ = ("tid", "tier", "lane", "queue", "source", "consumed",
-                 "finished", "done", "starved_windows", "manager",
-                 "pending_state", "ready", "parked", "parked_window")
+                 "submitted", "finished", "done", "starved_windows",
+                 "manager", "pending_state", "ready", "parked",
+                 "parked_window")
 
     def __init__(self, tid, tier: str, lane: int):
         self.tid = tid
@@ -476,6 +484,14 @@ class _Tenant:
         self.queue: deque = deque()
         self.source: Iterator | None = None
         self.consumed = 0  # chunks whose fold was dispatched
+        # Monotonic dispatch-order position of the NEXT enqueued chunk
+        # (resume base + chunks ever queued). The e2e watermark stamps
+        # key off this, NOT ``consumed + len(queue)``: the scheduler
+        # pops the queue and bumps ``consumed`` in two separate lock
+        # windows, so the sum transiently under-counts by one and a
+        # submit landing in that window would collide with (and lose)
+        # the previous chunk's stamp.
+        self.submitted = 0
         self.finished = False  # no more input will arrive
         self.done = False  # finished AND queue drained
         self.starved_windows = 0
@@ -688,8 +704,14 @@ class MultiTenantEngine:
         # whose resume position could still be overwritten.
         with self._lock:
             t.consumed = position
+            t.submitted = position
             t.source = source
             t.ready = True
+        if _telemetry_on():
+            # Seed the per-tenant e2e ledger at the exactly-once resume
+            # point: a resumed tenant's backlog re-ages from the
+            # re-submitted chunks' arrival, never the wall clock.
+            obs_bus.get_bus().watermarks.seed(tenant_id, position)
         self._work.set()
         return lane
 
@@ -716,6 +738,13 @@ class MultiTenantEngine:
         h = _normalize_chunk(chunk, batch.chunk_capacity)
         with self._lock:
             batch.check_template(h)
+            if _telemetry_on():
+                # Ingress stamp at the submit boundary, keyed by the
+                # chunk's dispatch-order position (queue is FIFO per
+                # tenant): the per-tenant e2e watermark's time zero.
+                obs_bus.get_bus().watermarks.stamp(
+                    tenant_id, t.submitted)
+            t.submitted += 1
             t.queue.append(h)
         self._work.set()
 
@@ -747,6 +776,10 @@ class MultiTenantEngine:
             batch.agg.codec_payload_check(h)
         with self._lock:
             batch.check_payload_template(h)
+            if _telemetry_on():
+                obs_bus.get_bus().watermarks.stamp(
+                    tenant_id, t.submitted)
+            t.submitted += 1
             t.queue.append(h)
         self._work.set()
 
@@ -818,6 +851,46 @@ class MultiTenantEngine:
     # for degree tiers — both the same snapshot indexing.
     labels = query
     degree = query
+
+    def telemetry(self) -> dict:
+        """Per-tenant serving-plane snapshot — the dict the STATS
+        endpoint ships (``TenantRouter.attach`` wires it into every
+        attached server's ``stats_fields``): position, queue depth,
+        backlog-age watermark, snapshot staleness and starvation per
+        tenant. Read-only; never blocks the scheduler beyond the table
+        lock."""
+        bus = obs_bus.get_bus()
+        wmk = bus.watermarks
+        # ONE locked pass (snapshot_window's fields read inline) so a
+        # row's position and staleness describe the same instant and a
+        # many-tenant STATS request takes the scheduler's table lock
+        # once, not once per tenant.
+        with self._lock:
+            rows = []
+            for t in self._tenants.values():
+                tier = self._tiers[t.tier]
+                if t.lane < 0:
+                    win = t.parked_window  # evicted: the parked row
+                elif t.lane >= tier.snapshot_lanes:
+                    win = 0  # admitted after the snapshot was taken
+                else:
+                    win = tier.snapshot_window
+                rows.append((t.tid, t.tier, t.lane, t.consumed,
+                             len(t.queue), t.done, t.starved_windows,
+                             win))
+        out = {}
+        for tid, tier_name, lane, pos, depth, done, starved, win in rows:
+            out[str(tid)] = {
+                "tier": tier_name,
+                "lane": lane,
+                "position": pos,
+                "queue_depth": depth,
+                "done": done,
+                "starved_windows": starved,
+                "backlog_age_s": round(wmk.backlog_age(tid), 6),
+                "snapshot_window": win,
+            }
+        return out
 
     def snapshot_window(self, tenant_id) -> int:
         """Window number the tenant's snapshot was taken at (0 = none
@@ -906,11 +979,15 @@ class MultiTenantEngine:
                 with self._lock:
                     if h is None:
                         t.finished = True
-                    elif batch.compressed:
-                        batch.check_payload_template(h)
-                        t.queue.append(h)
                     else:
-                        batch.check_template(h)
+                        if batch.compressed:
+                            batch.check_payload_template(h)
+                        else:
+                            batch.check_template(h)
+                        if _telemetry_on():
+                            obs_bus.get_bus().watermarks.stamp(
+                                t.tid, t.submitted)
+                        t.submitted += 1
                         t.queue.append(h)
             except Exception:
                 # Quarantine: one tenant's bad source/chunk must not
@@ -932,6 +1009,7 @@ class MultiTenantEngine:
             from ..obs.heartbeat import Heartbeat
 
             hb = Heartbeat(tracer.heartbeat_every_s)
+        gauge_next = 0.0  # first round always publishes
         while not self._stop.is_set():
             self._pull_sources()
             advanced = self._round(bus, tracer)
@@ -945,13 +1023,42 @@ class MultiTenantEngine:
             bus.gauge("tenants.queue_depth", queued)
             if self.publish_staged_gauge:
                 bus.gauge("pipeline.staged_depth", queued)
-            if hb is not None and hb.due():
+            backlog_max = 0.0
+            hb_due = hb is not None and hb.due()
+            if _telemetry_on():
+                # Rate-limited: each tenant's backlog_age is an
+                # O(pending) ledger scan under the shared watermark
+                # lock, so a busy scheduler must not pay N scans per
+                # dispatch round. Idle rounds and due heartbeats
+                # publish unconditionally (the converged view, and the
+                # beat's headline field, stay fresh); dispatching
+                # rounds refresh at most every 0.5 s.
+                now = _time.monotonic()
+                if not advanced or hb_due or now >= gauge_next:
+                    gauge_next = now + 0.5
+                    wmk = bus.watermarks
+                    with self._lock:
+                        tids = [t.tid for t in self._tenants.values()]
+                    for tid in tids:
+                        # Every tenant, done ones included: a drained
+                        # ledger publishes 0, so dashboards never show a
+                        # finished tenant's last in-flight age forever.
+                        age = wmk.backlog_age(tid)
+                        backlog_max = max(backlog_max, age)
+                        bus.gauge(f"tenants.t{tid}.backlog_age_s",
+                                  round(age, 6))
+                    bus.gauge("tenants.backlog_age_max_s",
+                              round(backlog_max, 6))
+            if hb_due:
                 hb.tick(
                     tenants_active=len(live),
                     tenants_queue_depth=queued,
                     windows=self.stats["windows_closed"],
                     chunks=self.stats["chunks"],
                     starved=self.stats["starved_lanes"],
+                    backlog_age_max_s=round(backlog_max, 3),
+                    round_p99_ms=round(
+                        bus.quantile("tenants.round_ms", 0.99), 3),
                 )
             if advanced:
                 continue
@@ -1019,6 +1126,8 @@ class MultiTenantEngine:
             starved = len(starved_tenants)
             batch = tier.batch
             t0 = tracer.now() if tracer is not None else 0.0
+            telemetry = _telemetry_on()
+            t_h = _time.perf_counter() if telemetry else 0.0
             with self._dispatch_lock:
                 batch.ensure_lanes(len(per_lane))
                 if batch.compressed:
@@ -1031,6 +1140,12 @@ class MultiTenantEngine:
                 act = jax.device_put(active, batch.sharding)
                 # ONE donated dispatch advances every lane of the tier.
                 batch.state = fold(batch.state, dev, act)
+            if telemetry:
+                # Per-round latency distribution (stack + H2D + batched
+                # fold dispatch): the signal a fair-share scheduler
+                # budgets rounds against.
+                bus.observe("tenants.round_ms",
+                            (_time.perf_counter() - t_h) * 1e3)
             with self._lock:
                 for t in took:
                     t.consumed += 1
@@ -1040,6 +1155,13 @@ class MultiTenantEngine:
                 self.stats["chunks"] += len(took)
                 if starved:
                     self.stats["starved_lanes"] += starved
+            if telemetry:
+                # Ingress→fold for every chunk this round advanced
+                # (per-tenant histograms; stamps stay until durable).
+                for t in took:
+                    bus.watermarks.retire_fold(
+                        t.tid, t.consumed, bus=bus,
+                        prefix=f"tenants.t{t.tid}")
             if starved:
                 bus.inc("tenants.starved_windows", starved)
             bus.inc("tenants.dispatches")
@@ -1101,6 +1223,18 @@ class MultiTenantEngine:
                 and tier.windows_closed - tier.last_ckpt_window
                 >= self.checkpoint_every):
             self._checkpoint_tier(tier)
+        elif self.checkpoint_dir is None and _telemetry_on():
+            # No durability point configured: the window close IS the
+            # retirement point — drain the tier's e2e ledgers so the
+            # watermark tracks fold retirement instead of growing
+            # forever.
+            with self._lock:
+                members = [(t.tid, t.consumed)
+                           for t in self._tenants.values()
+                           if t.tier == tier.name]
+            for tid, pos in members:
+                bus.watermarks.retire_durable(
+                    tid, pos, bus=bus, prefix=f"tenants.t{tid}")
         if self.reclaim_after is not None:
             self._maybe_reclaim(tier, bus, tracer)
 
@@ -1123,16 +1257,24 @@ class MultiTenantEngine:
                     if t.tier == tier.name and t.manager is not None
                     and t.lane >= 0
                 ]
+            telemetry = _telemetry_on()
             for t, position in members:
+                t_h = _time.perf_counter()
                 t.manager.save(
                     batch.slice_lane(t.lane), position,
                     meta={"tenant": str(t.tid), "tier": tier.name,
                           "window": tier.windows_closed},
                 )
+                b = obs_bus.get_bus()
                 obs_bus.publish_checkpoint(
-                    obs_bus.get_bus(), "tenants",
-                    t.manager.path_for(position),
+                    b, "tenants", t.manager.path_for(position), t0=t_h,
                 )
+                if telemetry:
+                    # The per-tenant durability point: ingress→durable
+                    # retires and the tenant's low watermark advances.
+                    b.watermarks.retire_durable(
+                        t.tid, position, bus=b,
+                        prefix=f"tenants.t{t.tid}")
         tier.last_ckpt_window = tier.windows_closed
 
     def _maybe_reclaim(self, tier: _Tier, bus, tracer) -> None:
@@ -1241,6 +1383,12 @@ class MultiTenantEngine:
                     tier.snapshot_lanes = 0
                 self.stats["reclaims"] += 1
                 self.stats["lanes_reclaimed"] += freed
+        if _telemetry_on():
+            # Evicted tenants fold nothing further: their e2e ledgers
+            # (already drained to the final checkpoint) are dropped so
+            # the max-backlog watermark never counts a parked row.
+            for t in evicted:
+                bus.watermarks.drop(t.tid)
         bus.inc("tenants.reclaims")
         bus.inc("tenants.lanes_reclaimed", freed)
         logger.info(

@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 from typing import Iterable
 
 import numpy as np
@@ -81,6 +82,15 @@ class IngestClient:
         self._resume_evt = threading.Event()
         self._resume_evt.set()
         self._rx_thread: threading.Thread | None = None
+        # In-flight STATS request slot: the reader thread parks the
+        # reply payload (and its echoed request token) here and sets
+        # the event (one request at a time — the single-sender
+        # discipline covers stats() too). The token lets stats()
+        # reject a straggler reply to an earlier timed-out request.
+        self._stats_evt = threading.Event()
+        self._stats_payload: bytes | None = None
+        self._stats_reply_token = 0
+        self._stats_token = 0
 
     # ---------------------------------------------------------- lifecycle
 
@@ -210,6 +220,40 @@ class IngestClient:
             n += 1
         return n
 
+    def stats(self, timeout: float = 5.0) -> dict:
+        """Ask the server for its live STATS snapshot ON THE DATA
+        CONNECTION — interleaves with DATA frames without touching the
+        stream's seq/ack state (the server answers mid-stream). Returns
+        the decoded JSON dict; for a stats read that must not share the
+        data socket, use :func:`gelly_tpu.obs.status.fetch_stats`.
+
+        The request carries a correlation token in the frame's seq
+        field (STATS seqs are never stream state; the server echoes
+        them back), so a straggler reply to an EARLIER timed-out
+        request can never satisfy this one with a stale snapshot."""
+        import json
+
+        with self._lock:
+            self._stats_token += 1
+            token = self._stats_token
+        self._stats_evt.clear()
+        self._raw_send(wire.pack_frame(wire.STATS, token))
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self._stats_evt.wait(remaining):
+                with self._lock:
+                    self._raise_rx_error_locked()
+                raise IngestError(f"no STATS reply within {timeout}s")
+            with self._lock:
+                if self._stats_reply_token == token:
+                    payload = self._stats_payload
+                    break
+                # A stale straggler (or a legacy seq-0 reply) — keep
+                # waiting for OUR token until the deadline.
+                self._stats_evt.clear()
+        return json.loads(payload.decode("utf-8"))
+
     def flush(self, timeout: float = 30.0) -> int:
         """Wait until the server has acked every sent frame; returns
         the acked seq. :class:`IngestError` on timeout."""
@@ -315,6 +359,11 @@ class IngestClient:
                             self._rx_error = e
                             self._cv.notify_all()
                         return
+                elif ftype == wire.STATS:
+                    with self._lock:
+                        self._stats_payload = _payload
+                        self._stats_reply_token = seq
+                    self._stats_evt.set()
                 elif ftype == wire.BYE:
                     return
         finally:

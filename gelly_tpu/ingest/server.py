@@ -43,6 +43,20 @@ Delivery contract:
   ignores PAUSE) until the depth drains to ``low_water``, then RESUME.
   Engagements are published as ``ingest.backpressure_engaged`` events
   and the ``ingest.paused`` gauge.
+- **Live introspection (STATS).** A ``STATS`` frame — on a dedicated
+  connection (``obs.status.fetch_stats`` / ``python -m
+  gelly_tpu.obs.status HOST:PORT``) or interleaved on the data
+  connection — is answered mid-stream with a JSON snapshot (counters,
+  gauges, histogram quantiles, per-tenant backlog-age watermarks, host
+  identity; ``stats_fields`` merges server-specific extras). STATS is
+  read-only: it never advances the expected sequence, never acks, and
+  a stats-only connection is never adopted as the data connection —
+  the DATA stream's exactly-once state is untouched. With telemetry
+  recording on (``obs.bus.recording()`` or an installed tracer) the
+  server additionally records the ``ingest.receive_to_stage_ms``
+  histogram and stamps each staged frame's ingress time into
+  ``bus.watermarks`` (stream key ``"stream"``), the source of the
+  end-to-end latency watermarks downstream consumers retire.
 """
 
 from __future__ import annotations
@@ -117,8 +131,18 @@ class IngestServer:
                  queue_depth: int = 64, high_water: int | None = None,
                  low_water: int | None = None, ack_every: int = 1,
                  auto_ack: bool = True, resume_seq: int = 0,
-                 pause_poll_s: float = 0.005, stop_on_bye: bool = False):
+                 pause_poll_s: float = 0.005, stop_on_bye: bool = False,
+                 stats_fields=None):
         self.host = host
+        # Optional zero-arg callable whose dict merges into every STATS
+        # reply (e.g. the tenant engine's per-tenant telemetry via
+        # TenantRouter). Failures are contained and reported in-band.
+        self.stats_fields = stats_fields
+        # Watermark ledger key staged frames are ingress-stamped under
+        # (telemetry-gated). "stream" matches the single-stream
+        # consumer's retire key; the TenantRouter re-keys attached
+        # servers (per-tenant ledgers own the watermark there).
+        self.watermark_stream = "stream"
         # One-shot servers (the example's --serve mode): a client BYE
         # ends the whole stream, so the consumer's iterator terminates.
         self.stop_on_bye = stop_on_bye
@@ -304,17 +328,27 @@ class IngestServer:
                 continue
             except OSError:
                 return  # listener closed by stop()
-            with self._state_lock:
-                old, self._conn_sock = self._conn_sock, sock
-            if old is not None:
-                # Latest connection wins (a reconnecting client's old
-                # socket may still look open server-side).
-                _close_quietly(old)
+            # Adoption as THE data connection is deferred to the first
+            # HELLO/DATA frame (_adopt): a read-only STATS connection
+            # must be answerable mid-stream without closing the live
+            # data socket out from under the streaming client.
             t = threading.Thread(
                 target=self._conn_loop, args=(sock, addr), daemon=True,
                 name="gelly-ingest-conn",
             )
             t.start()
+
+    def _adopt(self, sock: socket.socket) -> None:
+        """Make ``sock`` the (single) data connection — latest wins: a
+        reconnecting client's old socket may still look open
+        server-side. Called on the first stream frame, never for
+        STATS-only connections."""
+        with self._state_lock:
+            if self._conn_sock is sock:
+                return
+            old, self._conn_sock = self._conn_sock, sock
+        if old is not None:
+            _close_quietly(old)
 
     def _conn_loop(self, sock: socket.socket, addr) -> None:
         bus = obs_bus.get_bus()
@@ -353,6 +387,11 @@ class IngestServer:
                 except _ConnClosed:
                     return
                 faults_mod.inject("ingest")
+                # Frame-receive instant for the receive→stage latency
+                # histogram (telemetry-gated; cadence is per frame =
+                # per chunk, never per edge).
+                telemetry = obs_bus.telemetry_on()
+                t_rx = time.perf_counter() if telemetry else 0.0
                 bus.inc("ingest.frames_received")
                 bus.inc("ingest.bytes_received",
                         wire.HEADER_BYTES + len(payload))
@@ -366,18 +405,32 @@ class IngestServer:
                         expect = self._next_seq
                     self._send(sock, wire.pack_frame(wire.REJECT, expect))
                     continue
+                if ftype == wire.STATS:
+                    # Read-only introspection, answerable mid-stream:
+                    # touches neither the expected seq nor the ack
+                    # state, and never adopts this connection.
+                    self._answer_stats(sock, bus, seq)
+                    continue
                 if ftype == wire.HELLO:
+                    self._adopt(sock)
                     with self._state_lock:
                         expect = self._next_seq
                     self._send(sock, wire.pack_frame(wire.WELCOME, expect))
                     continue
                 if ftype == wire.BYE:
+                    with self._state_lock:
+                        is_data = self._conn_sock is sock
+                    if not is_data:
+                        # A stats-only (or never-handshaken) connection
+                        # closing is not the STREAM's goodbye.
+                        return
                     flush_tail()
                     if self.stop_on_bye:
                         self.stop()
                     return
                 if ftype not in (wire.DATA, wire.DATA_COMPRESSED):
                     continue  # unexpected control frame: ignore
+                self._adopt(sock)
                 compressed = ftype == wire.DATA_COMPRESSED
                 with self._state_lock:
                     expect = self._next_seq
@@ -405,6 +458,19 @@ class IngestServer:
                 # (so the staged depth never exceeds the high-water
                 # mark). Frames the client already pushed into kernel
                 # buffers wait there under TCP flow control.
+                if telemetry:
+                    # Ingress stamp BEFORE the admission wait: the e2e
+                    # watermark must count backpressure time — that is
+                    # the backlog the QoS round gates on. First-stamp-
+                    # wins keys this to the consumer's chunk positions
+                    # (seq == the engine's 0-based chunk index). Key
+                    # read + stamp under the state lock: a concurrent
+                    # TenantRouter.attach swaps the key and rekeys the
+                    # ledger under the same lock, so no stamp can land
+                    # under the old key after its ledger moved.
+                    with self._state_lock:
+                        bus.watermarks.stamp(self.watermark_stream,
+                                             seq)
                 self._apply_backpressure(sock, bus)
                 if not self._enqueue((seq, data, compressed)):
                     return  # stopped while staging
@@ -414,6 +480,9 @@ class IngestServer:
                         self._acked = seq + 1
                     acked = self._acked
                 bus.inc("ingest.chunks_enqueued")
+                if telemetry:
+                    bus.observe("ingest.receive_to_stage_ms",
+                                (time.perf_counter() - t_rx) * 1e3)
                 if compressed:
                     bus.inc("ingest.data_frames_compressed")
                 else:
@@ -432,6 +501,45 @@ class IngestServer:
             with self._state_lock:
                 if self._conn_sock is sock:
                     self._conn_sock = None
+
+    def _answer_stats(self, sock, bus, seq: int = 0) -> None:
+        """Reply to one STATS frame: a JSON snapshot of the current bus
+        (counters/gauges/histogram quantiles/watermarks/host identity)
+        plus the server's own sequencing view and any ``stats_fields``
+        extras. The request's ``seq`` is echoed on the reply — it is a
+        client-side correlation token (never stream state), letting
+        ``IngestClient.stats()`` reject a straggler reply to an earlier
+        timed-out request. Failures are contained — introspection must
+        never take the stream down."""
+        import json
+
+        from ..obs.status import build_stats
+
+        bus.inc("ingest.stats_requests")
+        extra: dict = {}
+        if self.stats_fields is not None:
+            try:
+                extra = dict(self.stats_fields())
+            except Exception as e:  # noqa: BLE001
+                extra = {"stats_fields_error":
+                         f"{type(e).__name__}: {e}"[:200]}
+        with self._state_lock:
+            extra["server"] = {
+                "port": self.port,
+                "next_seq": self._next_seq,
+                "acked": self._acked,
+                "durable": self._durable,
+                "staged_depth": self._q.qsize(),
+                "auto_ack": self.auto_ack,
+            }
+        try:
+            body = json.dumps(build_stats(bus, extra=extra),
+                              default=str).encode("utf-8")
+        except Exception as e:  # noqa: BLE001
+            body = json.dumps(
+                {"error": f"{type(e).__name__}: {e}"[:200]}
+            ).encode("utf-8")
+        self._send(sock, wire.pack_frame(wire.STATS, seq, body))
 
     def _enqueue(self, item) -> bool:
         import queue as queue_mod
@@ -516,7 +624,33 @@ class TenantRouter:
 
     def attach(self, server: IngestServer,
                default_tenant=None) -> threading.Thread:
-        """Start draining ``server`` (already started) into the engine."""
+        """Start draining ``server`` (already started) into the engine.
+        The server's STATS endpoint is wired to the engine's per-tenant
+        telemetry (positions, queue depths, backlog ages) unless the
+        caller installed its own ``stats_fields``."""
+        if server.stats_fields is None and hasattr(self.engine,
+                                                   "telemetry"):
+            server.stats_fields = (
+                lambda: {"tenants": self.engine.telemetry()}
+            )
+        # One wire ledger per attached server (distinct seq spaces must
+        # not collide on one key); drained as frames route (below).
+        # Frames staged between server.start() and this attach were
+        # ingress-stamped under the DEFAULT key — rekey carries those
+        # stamps along so the drain loop's retirement reaches them
+        # (left behind, they would read as permanently growing backlog
+        # nobody retires). Swap + rekey under the server's state lock,
+        # which the conn loop's stamp site also holds: a frame racing
+        # this attach either stamps the old key BEFORE the rekey (and
+        # moves with it) or sees the new key — never a stranded stamp.
+        # Attach before clients start streaming when multiple servers
+        # share one bus: the default key cannot tell two unattached
+        # servers' seq spaces apart.
+        with server._state_lock:
+            old_key = server.watermark_stream
+            server.watermark_stream = f"wire:{server.port}"
+            obs_bus.get_bus().watermarks.rekey(old_key,
+                                               server.watermark_stream)
         t = threading.Thread(
             target=self._drain_loop, args=(server, default_tenant),
             daemon=True, name="gelly-tenant-router",
@@ -599,6 +733,13 @@ class TenantRouter:
             # publish_staged_gauge below — so a paused client can't
             # strand the gauge above low_water.)
             bus.gauge("pipeline.staged_depth", self.engine.queue_depth())
+            if obs_bus.telemetry_on():
+                # Routed into a per-tenant queue: the per-tenant ledger
+                # (stamped by engine.submit*) owns the e2e watermark
+                # from here; drain this server's wire ledger so it
+                # never reads as backlog nobody will retire.
+                bus.watermarks.retire_durable(server.watermark_stream,
+                                              seq + 1)
 
 
 class _ConnClosed(Exception):

@@ -12,7 +12,7 @@ Frame layout (network byte order)::
 
     magic  u16   0x4749 ("GI")
     type   u8    HELLO/WELCOME/DATA/ACK/REJECT/PAUSE/RESUME/BYE/
-                 DATA_COMPRESSED
+                 DATA_COMPRESSED/STATS
     flags  u8    reserved (0)
     seq    u64   per-stream sequence number (DATA/DATA_COMPRESSED: the
                  chunk position; ACK/REJECT/WELCOME: the position being
@@ -56,9 +56,18 @@ BYE = 8      # either side: orderly close
 # staging — zero server-side compress work for bytes the producer
 # already reduced (the shared compression plane's wire leg).
 DATA_COMPRESSED = 9
+# Read-only live introspection (the serving-plane telemetry endpoint):
+# a client sends STATS with an empty payload, the server replies with a
+# STATS frame whose payload is UTF-8 JSON (obs/status.build_stats —
+# counters, gauges, histogram quantiles, per-tenant backlog watermarks,
+# host identity). STATS never carries stream data: it is answerable
+# MID-STREAM, rides the same CRC discipline, and touches neither the
+# expected sequence nor the ack state — on a dedicated connection the
+# server does not even adopt it as the data connection.
+STATS = 10
 
 FRAME_TYPES = (HELLO, WELCOME, DATA, ACK, REJECT, PAUSE, RESUME, BYE,
-               DATA_COMPRESSED)
+               DATA_COMPRESSED, STATS)
 
 # Bound on a single payload (64 MiB): a length prefix beyond it is
 # treated as a corrupt header, not an allocation request.

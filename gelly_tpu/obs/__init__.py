@@ -27,22 +27,47 @@ re-owns it:
   the same run (``utils.metrics.trace(log_dir, tracer=...)``) can be
   laid alongside it.
 - :mod:`~gelly_tpu.obs.heartbeat` — a periodic progress line (eps,
-  queue depths, last-retired position) for long streams.
+  queue depths, last-retired position, backlog-age watermark, p99 fold
+  dispatch) for long streams.
+- :mod:`~gelly_tpu.obs.histogram` — fixed-memory log-bucketed
+  :class:`StreamingHistogram` latency distributions
+  (``bus.observe(name, ms)``), recorded at the serving plane's hot
+  boundaries only when a tracer is installed or
+  :func:`~gelly_tpu.obs.bus.recording` is on.
+- :mod:`~gelly_tpu.obs.watermarks` — per-stream/per-tenant end-to-end
+  latency ledgers (``bus.watermarks``): ingress stamps ride the
+  exactly-once positions through fold and durability, and the oldest
+  unretired stamp IS the backlog-age low watermark QoS gates on.
+- :mod:`~gelly_tpu.obs.status` — the live STATS introspection endpoint:
+  ``python -m gelly_tpu.obs.status HOST:PORT`` asks a running ingest
+  server for a JSON snapshot mid-stream.
 """
 
-from .bus import EventBus, get_bus, scope
+from .bus import (
+    EventBus,
+    get_bus,
+    record_metrics,
+    recording,
+    scope,
+    set_recording,
+)
 from .export import (
     to_chrome_trace,
     validate_chrome_trace,
     write_chrome_trace,
 )
 from .heartbeat import Heartbeat
+from .histogram import StreamingHistogram
 from .tracing import SpanTracer, active_tracer, install
+from .watermarks import Watermarks
 
 __all__ = [
     "EventBus",
     "get_bus",
     "scope",
+    "recording",
+    "record_metrics",
+    "set_recording",
     "SpanTracer",
     "active_tracer",
     "install",
@@ -50,4 +75,6 @@ __all__ = [
     "validate_chrome_trace",
     "write_chrome_trace",
     "Heartbeat",
+    "StreamingHistogram",
+    "Watermarks",
 ]

@@ -1,4 +1,4 @@
-"""Process-wide event bus: counters, gauges, structured events.
+"""Process-wide event bus: counters, gauges, histograms, events.
 
 One default :class:`EventBus` exists per process (:func:`get_bus`) so
 runtime modules can publish without any wiring — the same stance as the
@@ -6,6 +6,17 @@ fault registry in ``engine/faults.py``. Publishing is a locked dict
 update (no I/O, no allocation beyond the event dict for :meth:`emit`),
 cheap enough to stay always-on at the cadences the runtime publishes at
 (per retry, per window close, per checkpoint — never per edge).
+
+Counters and gauges are ALWAYS-ON; histograms (:meth:`EventBus.observe`
+into a fixed-memory :class:`~gelly_tpu.obs.histogram.
+StreamingHistogram`) and the end-to-end latency watermarks
+(``bus.watermarks``, a :class:`~gelly_tpu.obs.watermarks.Watermarks`
+ledger) are GUARDED: the engine/ingest hot paths bind them only when a
+span tracer is installed or :func:`recording` is on (enable with
+:func:`record_metrics` scoped, or :func:`set_recording` for a
+long-running server) — the exact ``active_tracer() is not None``
+zero-cost-when-disabled discipline the tracer established, so a
+disabled run performs no histogram work, not even a clock read.
 
 Counter/gauge names are dotted, ``<subsystem>.<what>``:
 
@@ -49,6 +60,9 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
                                       (unknown tenant, no default)
 ``ingest.chunks_invalid``             tenant-router payloads dropped
                                       (bad ids/shapes/finished tenant)
+``ingest.stats_requests``             STATS introspection frames
+                                      answered (read-only; never
+                                      advances DATA sequencing)
 ``engine.units_folded``               pipeline units retired by a fold
 ``engine.chunks_folded``              chunks inside those units
 ``engine.edges_folded``               valid edges (tracer-enabled runs)
@@ -97,6 +111,49 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``sharded_cc.emissions_dense``        window closes emitting full labels
 ``sharded_cc.emissions_sparse``       window closes emitting dirty pairs
 ``sharded_cc.dirty_rows_gathered``    dirty rows pulled D2H, cumulative
+``engine.backlog_age_s``              oldest unretired ingress stamp's
+                                      age — the single-stream low
+                                      watermark (gauge; per-tenant
+                                      twins publish as
+                                      ``tenants.t<tid>.backlog_age_s``)
+``tenants.backlog_age_max_s``         worst per-tenant backlog age
+                                      (gauge — the QoS admission
+                                      headline)
+``obs.flight_dumps``                  flight-recorder trace dumps
+                                      written (dump_on triggers)
+====================================  =================================
+
+Histogram names (``bus.observe(name, value_ms)`` — latency
+distributions in MILLISECONDS, snapshot as p50/p90/p99/max; recorded
+only when a tracer is installed or :func:`recording` is on):
+
+====================================  =================================
+``engine.fold_dispatch_ms``           per-unit fold dispatch wall
+``engine.merge_emit_ms``              merge-window close + emission
+                                      barrier wall
+``engine.e2e_ingress_to_fold_ms``     chunk ingress (wire receive /
+                                      reader parse) → fold dispatch;
+                                      per-tenant twins publish as
+                                      ``tenants.t<tid>.…`` via the
+                                      same suffix
+``engine.e2e_ingress_to_durable_ms``  chunk ingress → covering
+                                      checkpoint durable (window close
+                                      on runs without a checkpoint
+                                      path); per-tenant twins as above
+``resilience.checkpoint_write_ms``    checkpoint write wall — one
+                                      ``<prefix>.checkpoint_write_ms``
+                                      histogram per checkpoint writer
+                                      (engine/resilience/tenants), via
+                                      :func:`publish_checkpoint`
+``ingest.receive_to_stage_ms``        wire frame fully received →
+                                      staged for the consumer
+``tenants.round_ms``                  one multi-tenant scheduling
+                                      round's batched fold dispatch
+``multiquery.emit_ms``                fused emission snapshot
+                                      publication at a window close
+                                      (lock wait + swap — the reader-
+                                      contention signal; the window's
+                                      compute wall is merge_emit_ms)
 ====================================  =================================
 
 Tests that need isolation wrap the block in :func:`scope`, which swaps
@@ -114,21 +171,30 @@ import contextlib
 
 
 class EventBus:
-    """Thread-safe counters + gauges + subscriber fan-out.
+    """Thread-safe counters + gauges + histograms + subscriber fan-out.
 
     - :meth:`inc` — add to a (float-valued) counter;
     - :meth:`gauge` — set a last-value gauge;
+    - :meth:`observe` — record a sample into a named
+      :class:`~gelly_tpu.obs.histogram.StreamingHistogram` (created on
+      first observation; fixed memory forever after);
     - :meth:`emit` — publish a structured event: bumps the
-      ``<name>`` counter, forwards the event dict to subscribers, and
-      records an instant event into the active span tracer (if one is
-      installed) so exported traces show retries/faults/degradations on
-      the timeline.
+      ``<name>`` counter, records an instant event into the active span
+      tracer (if one is installed — BEFORE the subscriber fan-out, so a
+      flight-recorder dump triggered by the event captures its own
+      instant), and forwards the event dict to subscribers.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
         self.counters: dict[str, float] = defaultdict(float)
         self.gauges: dict[str, float] = {}
+        self.histograms: dict = {}
+        from .watermarks import Watermarks
+
+        # The e2e-latency ledger rides the bus so scope() isolates it
+        # with the counters (see obs/watermarks.py).
+        self.watermarks = Watermarks()
         self._subs: list[Callable[[str, dict], None]] = []
 
     def inc(self, name: str, n: float = 1) -> None:
@@ -139,10 +205,43 @@ class EventBus:
         with self._lock:
             self.gauges[name] = value
 
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into the named histogram. Call sites on
+        hot paths must be guarded (tracer installed or
+        :func:`recording` on) — see the module docstring."""
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                from .histogram import StreamingHistogram
+
+                h = self.histograms[name] = StreamingHistogram()
+        h.record(value)
+
+    def histogram(self, name: str):
+        """The named :class:`StreamingHistogram`, or None if nothing
+        was ever observed into it."""
+        with self._lock:
+            return self.histograms.get(name)
+
+    def quantile(self, name: str, q: float, default: float = 0.0) -> float:
+        """Convenience quantile read (``default`` when the histogram
+        does not exist) — the heartbeat's p99 source."""
+        h = self.histogram(name)
+        return h.quantile(q) if h is not None else default
+
     def emit(self, name: str, **fields) -> None:
         with self._lock:
             self.counters[name] += 1
             subs = list(self._subs)
+        # Mirror onto the trace timeline FIRST: a flight-recorder dump
+        # subscribed to this event must find the event's own instant in
+        # the ring it exports. Imported lazily (bus must stay importable
+        # first — tracing imports nothing back from here).
+        from .tracing import active_tracer
+
+        tr = active_tracer()
+        if tr is not None:
+            tr.instant(name, **fields)
         for fn in subs:
             try:
                 fn(name, fields)
@@ -154,13 +253,6 @@ class EventBus:
 
                 logging.getLogger("gelly_tpu.obs").exception(
                     "event-bus subscriber failed on %r", name)
-        # Mirror onto the trace timeline. Imported lazily (bus must stay
-        # importable first — tracing imports nothing back from here).
-        from .tracing import active_tracer
-
-        tr = active_tracer()
-        if tr is not None:
-            tr.instant(name, **fields)
 
     def subscribe(self, fn: Callable[[str, dict], None]) -> Callable[[], None]:
         """Register ``fn(name, fields)`` for every :meth:`emit`; returns
@@ -176,22 +268,33 @@ class EventBus:
         return unsubscribe
 
     def snapshot(self) -> dict:
-        """Point-in-time copy ``{"counters": {...}, "gauges": {...}}``."""
+        """Point-in-time copy: counters, gauges, histogram quantile
+        snapshots and per-stream watermark states — all plain JSON
+        types (trace ``otherData`` and the STATS endpoint embed it
+        verbatim)."""
         with self._lock:
-            return {
-                "counters": dict(self.counters),
-                "gauges": dict(self.gauges),
-            }
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            hists = dict(self.histograms)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {k: h.snapshot() for k, h in hists.items()},
+            "watermarks": self.watermarks.snapshot(),
+        }
 
 
 def publish_checkpoint(bus: EventBus, prefix: str, path: str,
                        t0: float | None = None) -> int:
-    """Shared checkpoint-durability publishing (used by BOTH checkpoint
-    writers — ``engine/resilience.CheckpointManager`` and the aggregate
-    path's ``maybe_checkpoint``): bump ``<prefix>.checkpoints`` and
-    ``<prefix>.checkpoint_bytes`` (file size; 0 when unreadable), and
-    when ``t0`` (``time.perf_counter()`` at write start) is given, gauge
-    ``<prefix>.checkpoint_write_s``. Returns the byte count."""
+    """Shared checkpoint-durability publishing (used by ALL checkpoint
+    writers — ``engine/resilience.CheckpointManager``, the aggregate
+    path's ``maybe_checkpoint`` and the tenant engine): bump
+    ``<prefix>.checkpoints`` and ``<prefix>.checkpoint_bytes`` (file
+    size; 0 when unreadable), and when ``t0`` (``time.perf_counter()``
+    at write start) is given, gauge ``<prefix>.checkpoint_write_s`` —
+    plus, when telemetry recording is on (tracer installed or
+    :func:`recording`), the ``<prefix>.checkpoint_write_ms``
+    write-latency HISTOGRAM. Returns the byte count."""
     import os
     import time
 
@@ -202,14 +305,63 @@ def publish_checkpoint(bus: EventBus, prefix: str, path: str,
     bus.inc(f"{prefix}.checkpoints")
     bus.inc(f"{prefix}.checkpoint_bytes", size)
     if t0 is not None:
-        bus.gauge(f"{prefix}.checkpoint_write_s",
-                  round(time.perf_counter() - t0, 6))
+        dt = time.perf_counter() - t0
+        bus.gauge(f"{prefix}.checkpoint_write_s", round(dt, 6))
+        if telemetry_on():
+            bus.observe(f"{prefix}.checkpoint_write_ms", dt * 1e3)
     return size
 
 
 _DEFAULT = EventBus()
 _CURRENT: EventBus = _DEFAULT
 _SWAP_LOCK = threading.Lock()
+# Histogram/watermark recording enable (see module docstring): a
+# nesting count for record_metrics() scopes plus an absolute switch for
+# long-running servers (the example's --serve --stats).
+_RECORD_DEPTH = 0
+_RECORD_FORCED = False
+
+
+def recording() -> bool:
+    """True when histogram/watermark recording is enabled — THE
+    disabled-path check next to ``active_tracer() is not None``: hot
+    paths bind ``bus.observe``/``bus.watermarks`` once per run only
+    when one of the two is on."""
+    return _RECORD_DEPTH > 0 or _RECORD_FORCED
+
+
+def telemetry_on() -> bool:
+    """THE serving-plane telemetry guard, shared by every recording
+    site (engine/resilience/tenants/ingest): histograms and watermarks
+    record when :func:`recording` is on OR a span tracer is installed.
+    One definition, so a future change to the enablement rule cannot
+    silently split the zero-cost-when-disabled contract across
+    hand-copied guards."""
+    from .tracing import active_tracer
+
+    return recording() or active_tracer() is not None
+
+
+def set_recording(on: bool) -> None:
+    """Absolute recording switch (idempotent) for long-running
+    processes; scoped code should prefer :func:`record_metrics`."""
+    global _RECORD_FORCED
+    with _SWAP_LOCK:
+        _RECORD_FORCED = bool(on)
+
+
+@contextlib.contextmanager
+def record_metrics() -> Iterator[None]:
+    """Enable histogram/watermark recording for the dynamic extent
+    (nests; same shape as :func:`scope`)."""
+    global _RECORD_DEPTH
+    with _SWAP_LOCK:
+        _RECORD_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _SWAP_LOCK:
+            _RECORD_DEPTH -= 1
 
 
 def get_bus() -> EventBus:

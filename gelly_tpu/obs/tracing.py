@@ -19,6 +19,19 @@ Threading: spans are recorded from compress workers, the H2D thread and
 the consumer concurrently; ``deque.append`` is atomic under the GIL and
 the record is fully built before the append, so no lock is needed on
 the hot path.
+
+**Flight recorder** (rotating-segment mode): construct with
+``SpanTracer(segment_s=K, segments=N)`` and the ring becomes a bounded
+ring of N TIME segments — the newest ``N * K`` seconds of spans are
+retained regardless of record rate (eviction is whole oldest segments,
+counted in ``dropped``; ``capacity`` bounds records per segment as a
+memory backstop). :meth:`dump` exports the retained window as a valid
+Chrome trace at any moment, and :meth:`dump_on` subscribes to the event
+bus so an INCIDENT — an injected fault, a watchdog timeout, a
+degradation — automatically exports the spans surrounding it to a file,
+after the fact, with no debugger attached. ``EventBus.emit`` records
+the triggering instant into the tracer BEFORE the subscriber fan-out,
+so every flight dump contains its own incident marker.
 """
 
 from __future__ import annotations
@@ -46,7 +59,9 @@ class SpanTracer:
     """
 
     def __init__(self, capacity: int = 1 << 16,
-                 heartbeat_every_s: float | None = 10.0):
+                 heartbeat_every_s: float | None = 10.0,
+                 segment_s: float | None = None, segments: int = 8,
+                 clock=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         from collections import deque
@@ -54,26 +69,66 @@ class SpanTracer:
         self._ring: "deque[dict]" = deque(maxlen=capacity)
         self.capacity = capacity
         self.trace_id = os.urandom(8).hex()
-        self._clock = time.perf_counter
+        self._clock = clock if clock is not None else time.perf_counter
         self.t0 = self._clock()
         # The engine starts a Heartbeat at this cadence when the tracer
         # is installed; None disables it.
         self.heartbeat_every_s = heartbeat_every_s
         self.dropped = 0  # ring evictions are counted, never silent
         self._drop_lock = threading.Lock()
+        # Flight-recorder (rotating-segment) mode: retain the newest
+        # ``segments * segment_s`` seconds instead of the newest
+        # ``capacity`` records. ``capacity`` stays as the per-segment
+        # record bound (memory backstop against a record storm).
+        if segment_s is not None and segment_s <= 0:
+            raise ValueError(f"segment_s must be > 0, got {segment_s}")
+        if segments < 2:
+            raise ValueError(f"segments must be >= 2, got {segments}")
+        self.segment_s = segment_s
+        self.segments = segments
+        self._seg_lock = threading.Lock()
+        self._sealed: "deque[list]" = deque()
+        self._cur: list = []
+        self._seg_start = 0.0
+        self.dumps: list = []  # flight-dump paths, newest last
 
     # ------------------------------------------------------------ hot path
 
     def now(self) -> float:
         return self._clock() - self.t0
 
+    def _append(self, rec: dict) -> None:
+        if self.segment_s is None:
+            if len(self._ring) == self.capacity:
+                with self._drop_lock:
+                    self.dropped += 1
+            self._ring.append(rec)
+            return
+        ts = rec["ts"]
+        if ts - self._seg_start >= self.segment_s:
+            with self._seg_lock:
+                if ts - self._seg_start >= self.segment_s:
+                    # Seal the current segment; appenders that read the
+                    # old list reference land their record in the sealed
+                    # segment — retained either way.
+                    self._sealed.append(self._cur)
+                    self._cur = []
+                    self._seg_start = ts
+                    while len(self._sealed) > self.segments - 1:
+                        old = self._sealed.popleft()
+                        with self._drop_lock:
+                            self.dropped += len(old)
+        cur = self._cur
+        if len(cur) >= self.capacity:
+            with self._drop_lock:
+                self.dropped += 1
+            return
+        cur.append(rec)
+
     def span(self, stage: str, track: str, t0: float, **attrs) -> None:
         """Record ``[t0, now]`` as a completed span on ``track``."""
         t1 = self.now()
-        if len(self._ring) == self.capacity:
-            with self._drop_lock:
-                self.dropped += 1
-        self._ring.append({
+        self._append({
             "ph": "X", "name": stage, "track": track,
             "ts": t0, "dur": max(0.0, t1 - t0),
             "tid": threading.get_ident(),
@@ -82,10 +137,7 @@ class SpanTracer:
         })
 
     def instant(self, name: str, track: str = "events", **attrs) -> None:
-        if len(self._ring) == self.capacity:
-            with self._drop_lock:
-                self.dropped += 1
-        self._ring.append({
+        self._append({
             "ph": "i", "name": name, "track": track,
             "ts": self.now(),
             "tid": threading.get_ident(),
@@ -100,7 +152,14 @@ class SpanTracer:
         GIL-atomic copy; readers must go through it — a comprehension
         over the LIVE deque raises "deque mutated during iteration"
         when in-flight pipeline workers are still appending.)"""
-        return list(self._ring)
+        if self.segment_s is None:
+            return list(self._ring)
+        with self._seg_lock:
+            out: list = []
+            for seg in self._sealed:
+                out.extend(seg)
+            out.extend(self._cur)
+            return out
 
     def spans(self, stage: str | None = None) -> list[dict]:
         return [r for r in self.records()
@@ -109,6 +168,74 @@ class SpanTracer:
     def instants(self, name: str | None = None) -> list[dict]:
         return [r for r in self.records()
                 if r["ph"] == "i" and (name is None or r["name"] == name)]
+
+    # ------------------------------------------------------ flight recorder
+
+    # The default incident set dump_on() wires when called without
+    # event names: every injected fault, watchdog fire and
+    # native->fallback degradation exports the surrounding spans.
+    INCIDENT_EVENTS = ("faults.injected", "resilience.watchdog_timeouts",
+                       "resilience.degradations")
+
+    def dump(self, path: str, bus=None, extra: dict | None = None) -> dict:
+        """Export the currently retained ring as a validated Chrome
+        trace to ``path`` (works in both ring modes); returns the trace
+        dict. This is the after-the-fact read: the last
+        ``segments * segment_s`` seconds of spans around an incident,
+        without a debugger attached."""
+        from .export import write_chrome_trace
+
+        return write_chrome_trace(path, self, bus=bus, extra=extra)
+
+    def dump_on(self, *events: str, out_dir: str, bus=None,
+                limit: int = 8):
+        """Wire incident-triggered dumps: subscribe to ``bus`` (default:
+        the current :func:`~gelly_tpu.obs.bus.get_bus`) and, whenever
+        one of ``events`` (default :data:`INCIDENT_EVENTS` — injected
+        faults, watchdog timeouts, degradations) is emitted, export the
+        ring to ``out_dir/flight-<n>-<event>.json``. At most ``limit``
+        dumps per wiring (an incident storm must not turn the recorder
+        into a disk-filling incident of its own); paths land in
+        :attr:`dumps` and each dump bumps the ``obs.flight_dumps``
+        counter. Returns the unsubscribe callable."""
+        from . import bus as bus_mod
+
+        want = frozenset(events) if events else frozenset(
+            self.INCIDENT_EVENTS)
+        target_bus = bus if bus is not None else bus_mod.get_bus()
+        state = {"n": 0}
+        state_lock = threading.Lock()
+
+        def on_incident(name: str, fields: dict) -> None:
+            if name not in want:
+                return
+            with state_lock:
+                if state["n"] >= limit:
+                    return
+                n = state["n"]
+                state["n"] += 1
+            path = os.path.join(
+                out_dir, f"flight-{n:03d}-{name.replace('.', '_')}.json"
+            )
+            try:
+                self.dump(path, bus=target_bus, extra={
+                    "incident": name,
+                    "incident_fields": {k: repr(v)
+                                        for k, v in fields.items()},
+                })
+            except Exception:  # noqa: BLE001 — never fault the emitter
+                import logging
+
+                logging.getLogger("gelly_tpu.obs").exception(
+                    "flight-recorder dump for %r failed", name)
+                return
+            self.dumps.append(path)
+            # Count on the SUBSCRIBED bus: with an explicit ``bus=``
+            # the current bus at dump time may be a different scope —
+            # the counter must land next to the incident it counts.
+            target_bus.inc("obs.flight_dumps")
+
+        return target_bus.subscribe(on_incident)
 
 
 _ACTIVE: SpanTracer | None = None

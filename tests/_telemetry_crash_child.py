@@ -1,0 +1,136 @@
+"""Subprocess body for the telemetry SIGKILL test (test_telemetry.py)
+— the ``_ingest_crash_child.py`` harness pattern applied to the REAL
+engine serve path with serving-plane telemetry recording on.
+
+Runs an :class:`~gelly_tpu.ingest.server.IngestServer`
+(``auto_ack=False``) feeding ``run_aggregation`` over a DEGREES plan —
+the ±1 endpoint scatter is non-idempotent, so a double-folded acked
+chunk is visible in the final vector, keeping the parent's
+exactly-once assertion sharp. Acks follow durability: a daemon thread
+polls the engine checkpoint header and acks its recorded position.
+
+Telemetry under test: ``obs.set_recording(True)`` is on, so the run
+records fold-dispatch / checkpoint-write / receive→stage histograms
+and the ``"stream"`` e2e watermark ledger — the parent interleaves
+STATS requests mid-stream and asserts the JSON. Per closed window the
+child samples the backlog age and the oldest pending position into the
+output file; the parent asserts no sample is negative or
+wall-clock-sized (time travel), and that the RESUMED incarnation's
+oldest stamp never falls below the resumed position (the ledger
+re-seeds from the checkpoint position, not the wall clock).
+
+argv: <ckpt_path> <port_file> <out_npz> [chunk_sleep_s]
+"""
+
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_V = 128
+CHUNK = 16
+MERGE_EVERY = 2
+
+
+def main(argv):
+    ckpt_path, port_file, out_path = argv[0], argv[1], argv[2]
+    sleep_s = float(argv[3]) if len(argv) > 3 else 0.0
+
+    from gelly_tpu import obs
+    from gelly_tpu.engine.aggregation import run_aggregation
+    from gelly_tpu.engine.checkpoint import (
+        CheckpointCorruptError,
+        read_checkpoint_header,
+        save_checkpoint,
+    )
+    from gelly_tpu.ingest import IngestServer
+    from gelly_tpu.library.degrees import degree_aggregate
+
+    obs.set_recording(True)
+    bus = obs.get_bus()
+
+    resume = os.path.exists(ckpt_path)
+    pos = 0
+    if resume:
+        pos = int(read_checkpoint_header(ckpt_path)["position"])
+
+    srv = IngestServer(auto_ack=False, resume_seq=pos, queue_depth=8,
+                       stop_on_bye=True).start()
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(srv.port))
+    os.replace(tmp, port_file)
+
+    # Acks follow durability: poll the (atomically-replaced) engine
+    # checkpoint and ack its recorded position — the auto_ack=False
+    # half of the exactly-once contract, off the consumer thread so
+    # the tail window's ack never deadlocks against the client's
+    # flush().
+    stop_acker = threading.Event()
+
+    def acker():
+        while not stop_acker.is_set():
+            if os.path.exists(ckpt_path):
+                try:
+                    hdr = read_checkpoint_header(ckpt_path)
+                    srv.ack(int(hdr["position"]))
+                except (CheckpointCorruptError, OSError):
+                    pass  # mid-replace; next tick reads the new file
+            time.sleep(0.02)
+
+    t_ack = threading.Thread(target=acker, daemon=True)
+    t_ack.start()
+
+    agg = degree_aggregate(N_V)
+    # The engine's resume skips the first `pos` chunks of the stream —
+    # but the WIRE already resumed the sequence (resume_seq), so the
+    # socket only re-delivers the unacked suffix. Pad the skipped
+    # prefix with placeholders so absolute positions line up; the
+    # engine drops them unread (idx <= skip_until) and folds exactly
+    # the suffix the client retransmits.
+    import itertools
+
+    stream = itertools.chain(
+        iter([object()] * pos), srv.chunks(CHUNK, vertex_capacity=N_V)
+    )
+    res = run_aggregation(
+        agg, stream,
+        merge_every=MERGE_EVERY, checkpoint_path=ckpt_path,
+        checkpoint_every=1, resume=resume,
+    )
+    ages: list = []
+    oldest: list = []
+    final = None
+    try:
+        for final in res:
+            if sleep_s:
+                time.sleep(sleep_s)
+            ages.append(bus.watermarks.backlog_age("stream"))
+            op = bus.watermarks.oldest_position("stream")
+            oldest.append(-1 if op is None else op)
+    finally:
+        stop_acker.set()
+        srv.stop()
+    t_ack.join(timeout=5)
+    hdr = read_checkpoint_header(ckpt_path)
+    srv.ack(int(hdr["position"]))
+
+    save_checkpoint(
+        out_path,
+        {
+            "degrees": np.asarray(final, dtype=np.int64),
+            "ages": np.asarray(ages, dtype=np.float64),
+            "oldest": np.asarray(oldest, dtype=np.int64),
+        },
+        position=int(hdr["position"]),
+        meta={"resume_pos": pos, "resumed": resume},
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -85,3 +85,13 @@ def test_connected_components_fused_queries():
                  ["--queries=cc", "--checkpoint-dir=/tmp/x"])
     with pytest.raises(SystemExit, match="unknown --queries"):
         run_main("connected_components", ["--queries=nope"])
+
+
+def test_connected_components_stats_flag_validation():
+    # --stats shapes the SERVER's telemetry; alone it must refuse
+    # loudly, never silently enable process-wide recording.
+    with pytest.raises(SystemExit, match="pair it with --serve"):
+        run_main("connected_components", ["--stats"])
+    from gelly_tpu import obs
+
+    assert not obs.recording()  # the refusal never flipped the switch

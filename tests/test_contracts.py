@@ -830,3 +830,131 @@ def test_cli_list_rules_includes_contract_rules(capsys):
     out = capsys.readouterr().out
     for rid in ("EO001", "EO004", "WP001", "WP003", "OB001", "OB003"):
         assert rid in out
+
+
+# --------------------------------------------------------------------- #
+# OB rules: histogram kind (bus.observe) — ISSUE 14 satellite
+
+
+OB_HIST_BUS_SRC = '''\
+"""Mini event bus with a histogram glossary section.
+
+``app.frames``                        frames seen
+
+Histogram names:
+
+``app.fold_ms``                       fold dispatch wall
+``app.dead_hist_ms``                  documented but never observed
+"""
+'''
+
+
+def test_ob001_flags_undocumented_histogram(tmp_path):
+    mod = textwrap.dedent('''\
+        def publish(bus, dt):
+            bus.inc("app.frames")
+            bus.observe("app.fold_ms", dt)
+            bus.observe("app.rogue_ms", dt)              # H-OB001
+    ''')
+    findings = _lint_files(tmp_path, {"bus.py": OB_HIST_BUS_SRC,
+                                      "mod.py": mod})
+    got = {(f.rule, os.path.basename(f.path), f.line) for f in findings}
+    assert ("OB001", "mod.py", _line_of(mod, "H-OB001")) in got
+    (f001,) = [f for f in findings if f.rule == "OB001"]
+    assert "histogram" in f001.message
+
+
+def test_ob002_flags_dead_histogram_entry(tmp_path):
+    mod = textwrap.dedent('''\
+        def publish(bus, dt):
+            bus.inc("app.frames")
+            bus.observe("app.fold_ms", dt)
+    ''')
+    findings = _lint_files(tmp_path, {"bus.py": OB_HIST_BUS_SRC,
+                                      "mod.py": mod})
+    assert [(f.rule, os.path.basename(f.path), f.line)
+            for f in findings] \
+        == [("OB002", "bus.py",
+             _line_of(OB_HIST_BUS_SRC, "app.dead_hist_ms"))]
+
+
+def test_ob002_wildcard_observe_site_covers_histogram_family(tmp_path):
+    # The watermark-ledger idiom: one f-string observe site publishes
+    # the whole <prefix>.e2e_ingress_to_fold_ms family — it must count
+    # as emitting the documented representative, both ways.
+    bus = ('"""Glossary.\n'
+           '\n'
+           '``app.frames``      frames seen\n'
+           '``eng.e2e_ms``      e2e latency family representative\n'
+           '"""\n')
+    mod = textwrap.dedent('''\
+        def publish(bus, prefix, dt):
+            bus.inc("app.frames")
+            bus.observe(f"{prefix}.e2e_ms", dt)
+    ''')
+    findings = _lint_files(tmp_path, {"bus.py": bus, "mod.py": mod})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_ob003_counter_histogram_collision_both_ways(tmp_path):
+    # Collision across the NEW kind: one name inc'd and observe'd. The
+    # finding anchors at the higher-precedence site (the histogram),
+    # never both — and a name used consistently as a histogram in two
+    # places stays clean.
+    bus = ('"""Glossary.\n'
+           '\n'
+           '``app.mixed_ms``    oops, counter and histogram\n'
+           '``app.clean_ms``    histogram in two modules\n'
+           '"""\n')
+    mod = textwrap.dedent('''\
+        def publish(bus, dt):
+            bus.inc("app.mixed_ms")
+            bus.observe("app.mixed_ms", dt)              # H-OB003
+            bus.observe("app.clean_ms", dt)
+    ''')
+    mod2 = textwrap.dedent('''\
+        def publish2(bus, dt):
+            bus.observe("app.clean_ms", dt)
+    ''')
+    findings = _lint_files(tmp_path, {"bus.py": bus, "mod.py": mod,
+                                      "mod2.py": mod2})
+    assert [(f.rule, f.line) for f in findings] \
+        == [("OB003", _line_of(mod, "H-OB003"))]
+    assert "histogram" in findings[0].message
+
+
+def test_ob003_gauge_histogram_collision_flags_histogram_site(tmp_path):
+    mod = textwrap.dedent('''\
+        def publish(bus, dt):
+            bus.gauge("app.depth_ms", dt)
+            bus.observe("app.depth_ms", dt)              # GH-OB003
+    ''')
+    findings = _lint_src(tmp_path, mod, name="mod.py")
+    assert [(f.rule, f.line) for f in findings] \
+        == [("OB003", _line_of(mod, "GH-OB003"))]
+
+
+def test_tip_histogram_glossary_covers_issue14_metrics():
+    # The ISSUE 14 histogram set must be documented AND emitted on tip:
+    # deleting a call site without the glossary entry (or the reverse)
+    # regresses here the same way the PR 11 audit names do.
+    import gelly_tpu
+
+    root = os.path.dirname(gelly_tpu.__file__)
+    c = contracts.ContractChecker(root)
+    findings = c.lint_paths([root])
+    assert [f for f in findings if f.rule.startswith("OB")] == []
+    for name in ("engine.fold_dispatch_ms", "engine.merge_emit_ms",
+                 "resilience.checkpoint_write_ms",
+                 "ingest.receive_to_stage_ms", "tenants.round_ms",
+                 "multiquery.emit_ms", "engine.e2e_ingress_to_fold_ms",
+                 "engine.e2e_ingress_to_durable_ms"):
+        assert name in c._glossary, name
+    hist_sites = {s.name for s in c._emits if s.kind == "histogram"}
+    assert {"engine.fold_dispatch_ms", "engine.merge_emit_ms",
+            "ingest.receive_to_stage_ms", "tenants.round_ms",
+            "multiquery.emit_ms"} <= hist_sites
+    # the watermark ledger's wildcard families
+    assert {".e2e_ingress_to_fold_ms", ".e2e_ingress_to_durable_ms",
+            ".checkpoint_write_ms"} <= {
+        s.name for s in c._emits if s.kind == "histogram" and s.wildcard}

@@ -421,3 +421,320 @@ def test_heartbeat_concurrent_ticks_stamp_unique_beat_numbers():
     beat_nos = [line["beat"] for line in hb.lines]
     assert len(beat_nos) == total
     assert sorted(beat_nos) == list(range(1, total + 1))
+
+
+# --------------------------------------------------------------------- #
+# streaming histograms (ISSUE 14: fixed-memory log-bucketed latency
+# distributions on the bus, zero-cost when disabled)
+
+
+def test_histogram_quantiles_and_extrema():
+    h = obs.StreamingHistogram()
+    for v in range(1, 101):  # 1..100 ms
+        h.record(float(v))
+    s = h.snapshot()
+    assert s["count"] == 100
+    assert s["min"] == 1.0 and s["max"] == 100.0
+    # Log-bucket estimate: within one bucket (<= ~9% relative error).
+    assert 45.0 <= s["p50"] <= 60.0
+    assert 85.0 <= s["p90"] <= 100.0
+    assert s["p99"] <= 100.0  # clamped at the exact max
+    assert s["p50"] <= s["p90"] <= s["p99"]
+
+
+def test_histogram_merge_and_edge_values():
+    a, b = obs.StreamingHistogram(), obs.StreamingHistogram()
+    a.record(1.0)
+    a.record(2.0)
+    b.record(1000.0)
+    b.record(-5.0)   # clamps into the lowest bucket, never raises
+    b.record(float("nan"))
+    a.merge(b)
+    s = a.snapshot()
+    assert s["count"] == 5
+    assert s["max"] == 1000.0
+    assert a.quantile(1.0) == 1000.0
+    e = obs.StreamingHistogram()
+    assert e.quantile(0.5) == 0.0 and e.snapshot()["count"] == 0
+    with pytest.raises(ValueError, match="q must be"):
+        e.quantile(1.5)
+
+
+def test_histogram_single_sample_reports_its_value():
+    h = obs.StreamingHistogram()
+    h.record(3.7)
+    s = h.snapshot()
+    assert s["p50"] == s["p99"] == 3.7  # clamped to exact extrema
+
+
+@pytest.mark.racecheck
+def test_histogram_concurrent_records_lose_nothing():
+    import threading
+
+    h = obs.StreamingHistogram()
+    n_threads, per_thread = 8, 500
+
+    def hammer(i):
+        for j in range(per_thread):
+            h.record(float(i * per_thread + j + 1))
+
+    ts = [threading.Thread(target=hammer, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert h.snapshot()["count"] == n_threads * per_thread
+
+
+def test_bus_observe_snapshot_and_scope_isolation():
+    with obs.scope() as bus:
+        bus.observe("engine.fold_dispatch_ms", 2.0)
+        bus.observe("engine.fold_dispatch_ms", 4.0)
+        snap = bus.snapshot()
+        assert snap["histograms"]["engine.fold_dispatch_ms"]["count"] == 2
+        assert bus.quantile("engine.fold_dispatch_ms", 1.0) == 4.0
+        assert bus.quantile("missing", 0.5, default=-1.0) == -1.0
+    # scope isolation: the outer bus never saw the histogram
+    assert "engine.fold_dispatch_ms" not in obs.get_bus().snapshot()[
+        "histograms"]
+
+
+def test_recording_flag_scoped_and_forced():
+    assert not obs.recording()
+    with obs.record_metrics():
+        assert obs.recording()
+        with obs.record_metrics():
+            assert obs.recording()
+        assert obs.recording()
+    assert not obs.recording()
+    obs.set_recording(True)
+    try:
+        assert obs.recording()
+    finally:
+        obs.set_recording(False)
+    assert not obs.recording()
+
+
+def test_histograms_and_watermarks_zero_work_when_disabled():
+    # Neither a tracer nor recording: the run must not create a single
+    # histogram or watermark entry (the zero-cost contract's observable
+    # half; the guard itself is `telemetry`-bound once per run).
+    assert obs.active_tracer() is None and not obs.recording()
+    with obs.scope() as bus:
+        _run_cc(tracer=None)
+        snap = bus.snapshot()
+    assert snap["histograms"] == {}
+    assert snap["watermarks"] == {}
+
+
+def test_recording_without_tracer_populates_histograms_and_watermarks(
+        tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    with obs.scope() as bus, obs.record_metrics():
+        s = edge_stream_from_edges(EDGES, vertex_capacity=32, chunk_size=2)
+        agg = connected_components(32)
+        s.aggregate(agg, merge_every=2, checkpoint_path=ck).result()
+        snap = bus.snapshot()
+    hists = snap["histograms"]
+    # The hot boundaries: fold dispatch, merge close, checkpoint write,
+    # plus the e2e ingress→fold/durable pair.
+    for name in ("engine.fold_dispatch_ms", "engine.merge_emit_ms",
+                 "engine.checkpoint_write_ms",
+                 "engine.e2e_ingress_to_fold_ms",
+                 "engine.e2e_ingress_to_durable_ms"):
+        assert hists[name]["count"] >= 1, name
+        assert hists[name]["p99"] >= hists[name]["p50"] >= 0.0
+    # 4 units folded -> 4 fold-dispatch samples
+    assert hists["engine.fold_dispatch_ms"]["count"] == 4
+    # End of stream: every stamp retired durable, backlog age is zero.
+    assert snap["watermarks"]["stream"]["pending"] == 0
+    assert snap["gauges"]["engine.backlog_age_s"] == 0.0
+
+
+def test_watermarks_ledger_semantics():
+    clock = [100.0]
+    wm = obs.Watermarks(clock=lambda: clock[0])
+    wm.seed("s", 2)
+    wm.stamp("s", 1)           # below the seed base: dropped
+    wm.stamp("s", 2)
+    clock[0] = 101.0
+    wm.stamp("s", 3)
+    wm.stamp("s", 2, t=999.0)  # first stamp wins
+    assert wm.oldest_position("s") == 2
+    clock[0] = 104.0
+    assert wm.backlog_age("s") == pytest.approx(4.0)
+    assert wm.max_backlog_age() == pytest.approx(4.0)
+    bus = obs.EventBus()
+    wm.retire_fold("s", 3, bus=bus, prefix="engine")
+    wm.retire_fold("s", 3, bus=bus, prefix="engine")  # once per position
+    assert bus.snapshot()["histograms"][
+        "engine.e2e_ingress_to_fold_ms"]["count"] == 1
+    wm.retire_durable("s", 3, bus=bus, prefix="engine")
+    assert wm.oldest_position("s") == 3
+    assert bus.snapshot()["histograms"][
+        "engine.e2e_ingress_to_durable_ms"]["count"] == 1
+    wm.retire_durable("s", 4, bus=bus, prefix="engine")
+    assert wm.backlog_age("s") == 0.0
+    assert wm.snapshot()["s"]["pending"] == 0
+    # unknown streams read as empty, never raise
+    assert wm.backlog_age("nope") == 0.0
+    assert wm.oldest_position("nope") is None
+    wm.drop("s")
+    assert wm.snapshot() == {}
+
+
+def test_watermarks_rekey_moves_and_merges_ledgers():
+    """Regression: TenantRouter.attach re-keys a started server's
+    watermark stream — stamps recorded under the old key must follow
+    (left behind they read as permanently growing backlog nobody
+    retires)."""
+    clock = [10.0]
+    wm = obs.Watermarks(clock=lambda: clock[0])
+    wm.stamp("stream", 0)
+    wm.stamp("stream", 1)
+    wm.rekey("stream", "wire:1234")
+    assert wm.snapshot() == {
+        "wire:1234": {"backlog_age_s": 0.0, "oldest_position": 0,
+                      "pending": 2, "base": 0},
+    }
+    # Retirement under the NEW key reaches the moved stamps.
+    wm.retire_durable("wire:1234", 2)
+    assert wm.backlog_age("wire:1234") == 0.0
+    assert wm.max_backlog_age() == 0.0
+    # Merge semantics: first-stamp-wins into an existing ledger,
+    # bases maxed, sub-base stragglers dropped.
+    wm.seed("a", 2)
+    wm.stamp("a", 3, t=1.0)
+    wm.stamp("b", 1, t=5.0)  # below a's base: dropped by the merge
+    wm.stamp("b", 3, t=9.0)  # position collision: a's stamp wins
+    wm.stamp("b", 4, t=2.0)
+    wm.rekey("b", "a")
+    snap = wm.snapshot()["a"]
+    assert snap["pending"] == 2 and snap["base"] == 2
+    clock[0] = 11.0
+    assert wm.backlog_age("a") == pytest.approx(10.0)  # t=1.0 survived
+    # rekey of an absent stream is a no-op, never raises
+    wm.rekey("ghost", "a")
+    assert wm.snapshot()["a"]["pending"] == 2
+
+
+def test_heartbeat_carries_serving_plane_fields():
+    tr = obs.SpanTracer(heartbeat_every_s=0.0)  # beat on every unit
+    with obs.scope():
+        _run_cc(tracer=tr)
+    beats = tr.instants("heartbeat")
+    assert beats
+    last = beats[-1]["args"]
+    # ISSUE 14 satellite: backlog-age watermark, p99 fold dispatch,
+    # staged-depth high-water since the last beat.
+    assert last["backlog_age_max_s"] >= 0.0
+    assert last["fold_p99_ms"] >= 0.0
+    assert last["staged_hw"] >= 0
+
+
+# --------------------------------------------------------------------- #
+# flight recorder (rotating segments + incident-triggered dumps)
+
+
+def test_tracer_segment_rotation_retains_newest_window():
+    clock = [0.0]
+    tr = obs.SpanTracer(segment_s=1.0, segments=3,
+                        clock=lambda: clock[0])
+    for i in range(10):
+        clock[0] = float(i)
+        tr.instant("e", i=i)
+    kept = [r["args"]["i"] for r in tr.records()]
+    # 3 segments x 1s: the newest 3 seconds survive; evictions counted.
+    assert kept == [7, 8, 9]
+    assert tr.dropped == 7
+    with pytest.raises(ValueError, match="segment_s"):
+        obs.SpanTracer(segment_s=0.0)
+    with pytest.raises(ValueError, match="segments"):
+        obs.SpanTracer(segment_s=1.0, segments=1)
+
+
+def test_tracer_segment_capacity_backstop():
+    clock = [0.0]
+    tr = obs.SpanTracer(capacity=4, segment_s=100.0, segments=2,
+                        clock=lambda: clock[0])
+    for i in range(10):
+        tr.instant("e", i=i)
+    assert len(tr.records()) == 4  # per-segment record bound
+    assert tr.dropped == 6
+
+
+@pytest.mark.faults
+def test_flight_recorder_dumps_on_injected_fault(tmp_path):
+    plan = faults.FaultPlan([faults.Fault("codec", at=1, count=1)])
+    tr = obs.SpanTracer(heartbeat_every_s=None, segment_s=10.0,
+                        segments=4)
+    with obs.scope() as bus:
+        unsub = tr.dump_on(out_dir=str(tmp_path), bus=bus)
+        with obs.install(tr), faults.install(plan):
+            s = edge_stream_from_edges(EDGES, vertex_capacity=32,
+                                       chunk_size=2)
+            agg = connected_components(32)
+            with pytest.raises(faults.FaultInjected):
+                s.aggregate(agg, merge_every=2).result()
+        unsub()
+        counters = bus.snapshot()["counters"]
+    assert len(tr.dumps) == 1
+    trace = json.loads(open(tr.dumps[0]).read())
+    obs.validate_chrome_trace(trace)  # the acceptance bar: valid trace
+    names = {e["name"] for e in trace["traceEvents"]}
+    # The spans surrounding the incident AND the incident marker itself
+    # (emit() records the instant BEFORE the subscriber fan-out).
+    assert "faults.injected" in names
+    assert names & {"produce", "compress", "fold"}
+    assert trace["otherData"]["incident"] == "faults.injected"
+    assert counters["obs.flight_dumps"] == 1
+
+
+def test_flight_recorder_dump_limit_and_default_events(tmp_path):
+    tr = obs.SpanTracer(segment_s=10.0, segments=2)
+    with obs.scope() as bus:
+        unsub = tr.dump_on(out_dir=str(tmp_path), bus=bus, limit=2)
+        # Default incident set: faults, watchdog timeouts, degradations.
+        bus.emit("resilience.watchdog_timeouts", boundary="step")
+        bus.emit("resilience.degradations", stem="x")
+        bus.emit("faults.injected", boundary="h2d")  # over the limit
+        bus.emit("unrelated.event")
+        unsub()
+        bus.emit("faults.injected", boundary="h2d")  # after unsubscribe
+    assert len(tr.dumps) == 2  # limit honored; storms never fill disk
+    for p in tr.dumps:
+        obs.validate_chrome_trace(json.loads(open(p).read()))
+    assert "watchdog" in tr.dumps[0]
+
+
+def test_emit_records_instant_before_subscriber_fanout():
+    tr = obs.SpanTracer()
+    seen = []
+    bus = obs.EventBus()
+    bus.subscribe(
+        lambda name, fields: seen.append(len(tr.instants(name))))
+    with obs.install(tr):
+        bus.emit("x.incident", k=1)
+    # By the time the subscriber (a flight-recorder dump) runs, the
+    # incident's own instant is already in the ring it would export.
+    assert seen == [1]
+
+
+def test_publish_checkpoint_histogram_gated_on_recording(tmp_path):
+    import time as _t
+
+    from gelly_tpu.obs import bus as bus_mod
+
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"x" * 64)
+    with obs.scope() as bus:
+        bus_mod.publish_checkpoint(bus, "engine", str(p),
+                                   t0=_t.perf_counter())
+        assert bus.snapshot()["histograms"] == {}  # recording off
+        with obs.record_metrics():
+            bus_mod.publish_checkpoint(bus, "engine", str(p),
+                                       t0=_t.perf_counter())
+        snap = bus.snapshot()
+    assert snap["histograms"]["engine.checkpoint_write_ms"]["count"] == 1
+    assert snap["counters"]["engine.checkpoints"] == 2

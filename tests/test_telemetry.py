@@ -1,0 +1,597 @@
+"""Serving-plane telemetry: the STATS endpoint, e2e watermarks, and
+their exactly-once interplay (ISSUE 14 acceptance).
+
+The headline test interleaves STATS requests with a live DATA stream
+feeding the REAL engine serve path, SIGKILLs the server mid-stream and
+proves (a) the STATS replies are valid JSON carrying per-stream
+backlog-age watermarks and p50/p99 for the fold-dispatch /
+checkpoint-write / receive→stage histograms, (b) the interleaving never
+perturbed DATA sequencing — the resumed run's non-idempotent degree
+fold lands bit-identical to the oracle (exactly-once), and (c) the
+watermark ledger never publishes a negative or time-travelling backlog
+age, re-seeding from the RESUMED POSITION after the crash.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gelly_tpu import obs
+from gelly_tpu.ingest import IngestClient, IngestServer
+from gelly_tpu.ingest.client import edge_payload
+from gelly_tpu.obs.status import build_stats, fetch_stats
+
+N_V = 128
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_telemetry_crash_child.py")
+
+
+# --------------------------------------------------------------------- #
+# STATS endpoint basics (fast, in-process)
+
+
+def _start_server(**kw):
+    return IngestServer(port=0, **kw).start()
+
+
+def test_stats_dedicated_connection_never_disturbs_data_stream():
+    """A stats-only connection is answered mid-stream and never adopted
+    as the data connection: the in-flight DATA stream keeps its socket,
+    its sequence, and its acks."""
+    with obs.scope() as bus, obs.record_metrics():
+        srv = _start_server()
+        try:
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            rng = np.random.default_rng(5)
+            for _ in range(3):
+                cli.send(edge_payload(rng.integers(0, N_V, 8),
+                                      rng.integers(0, N_V, 8)))
+            cli.flush(timeout=30)
+            st = fetch_stats("127.0.0.1", srv.port)
+            assert st["server"]["next_seq"] == 3
+            assert st["counters"]["ingest.data_frames_raw"] == 3
+            assert "stream" in st["watermarks"]
+            assert st["histograms"]["ingest.receive_to_stage_ms"][
+                "count"] == 3
+            for q in ("p50", "p90", "p99", "max"):
+                assert st["histograms"]["ingest.receive_to_stage_ms"][
+                    q] >= 0
+            # The data stream is alive and sequenced AFTER the stats
+            # read: more frames flow and ack on the same connection.
+            for _ in range(2):
+                cli.send(edge_payload(rng.integers(0, N_V, 8),
+                                      rng.integers(0, N_V, 8)))
+            assert cli.flush(timeout=30) == 5
+            assert srv.next_seq == 5
+            assert bus.snapshot()["counters"][
+                "ingest.stats_requests"] == 1
+            cli.close(flush_timeout=None)
+        finally:
+            srv.stop()
+
+
+def test_client_stats_interleaves_on_the_data_connection():
+    with obs.scope(), obs.record_metrics():
+        srv = _start_server()
+        try:
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            rng = np.random.default_rng(6)
+            cli.send(edge_payload(rng.integers(0, N_V, 8),
+                                  rng.integers(0, N_V, 8)))
+            st = cli.stats()
+            assert st["server"]["next_seq"] == 1
+            assert st["recording"] is True
+            # Sequencing untouched: the next DATA frame is seq 1.
+            assert cli.send(edge_payload(rng.integers(0, N_V, 8),
+                                         rng.integers(0, N_V, 8))) == 1
+            assert cli.flush(timeout=30) == 2
+            cli.close(flush_timeout=None)
+        finally:
+            srv.stop()
+
+
+def test_stats_fields_extras_and_failure_containment():
+    calls = {"n": 0}
+
+    def fields():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return {"custom": {"answer": 42}}
+        raise RuntimeError("stats provider broke")
+
+    with obs.scope():
+        srv = _start_server(stats_fields=fields)
+        try:
+            st = fetch_stats("127.0.0.1", srv.port)
+            assert st["custom"] == {"answer": 42}
+            # A raising provider is contained, reported in-band, and
+            # the stream/server stays up.
+            st2 = fetch_stats("127.0.0.1", srv.port)
+            assert "stats provider broke" in st2["stats_fields_error"]
+        finally:
+            srv.stop()
+
+
+def test_status_cli_prints_snapshot(capsys):
+    with obs.scope():
+        srv = _start_server()
+        try:
+            from gelly_tpu.obs import status as status_mod
+
+            rc = status_mod.main([f"127.0.0.1:{srv.port}"])
+            assert rc == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["server"]["port"] == srv.port
+            assert "counters" in out and "watermarks" in out
+            assert status_mod.main(["not-a-target"]) == 2
+        finally:
+            srv.stop()
+
+
+def test_build_stats_shape_is_json_ready():
+    with obs.scope() as bus, obs.record_metrics():
+        bus.inc("ingest.frames_received")
+        bus.observe("engine.fold_dispatch_ms", 1.5)
+        bus.watermarks.stamp("stream", 0)
+        st = json.loads(json.dumps(build_stats(bus)))
+    assert st["counters"]["ingest.frames_received"] == 1
+    assert st["histograms"]["engine.fold_dispatch_ms"]["count"] == 1
+    assert st["watermarks"]["stream"]["pending"] == 1
+    assert "process_index" in st["host"]
+
+
+# --------------------------------------------------------------------- #
+# tenant engine telemetry through the router
+
+
+@pytest.mark.tenants
+def test_tenant_router_wires_engine_telemetry_into_stats(tmp_path):
+    from gelly_tpu.engine.tenants import MultiTenantEngine
+    from gelly_tpu.ingest import TenantRouter
+    from gelly_tpu.library.connected_components import cc_tenant_tier
+
+    with obs.scope() as bus, obs.record_metrics():
+        agg, cap = cc_tenant_tier(N_V, chunk_capacity=16)
+        eng = MultiTenantEngine(merge_every=1).start()
+        router = TenantRouter(eng, "small", vertex_capacity=N_V)
+        eng.add_tier("small", agg, cap)
+        srv = _start_server()
+        try:
+            router.attach(srv)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            rng = np.random.default_rng(11)
+            for t in (3, 4):
+                for _ in range(2):
+                    p = edge_payload(rng.integers(0, N_V, 8),
+                                     rng.integers(0, N_V, 8))
+                    p["tenant"] = np.array([t], np.int64)
+                    cli.send(p)
+            cli.flush(timeout=30)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    if eng.position(3) >= 2 and eng.position(4) >= 2:
+                        break
+                except KeyError:
+                    pass
+                time.sleep(0.02)
+            st = fetch_stats("127.0.0.1", srv.port)
+            assert set(st["tenants"]) >= {"3", "4"}
+            for tid in ("3", "4"):
+                row = st["tenants"][tid]
+                assert row["position"] >= 2
+                assert row["backlog_age_s"] >= 0.0
+                assert row["tier"] == "small"
+            # Per-tenant e2e histograms + the round histogram recorded.
+            snap = bus.snapshot()
+            assert "tenants.round_ms" in snap["histograms"]
+            assert "tenants.t3.e2e_ingress_to_fold_ms" in snap[
+                "histograms"]
+            assert snap["gauges"]["tenants.backlog_age_max_s"] >= 0.0
+            cli.close(flush_timeout=None)
+        finally:
+            srv.stop()
+            router.stop()
+            eng.stop()
+
+
+def test_router_attach_rekeys_preattach_wire_stamps():
+    """Regression: frames staged between server.start() and
+    router.attach() are ingress-stamped under the server's DEFAULT
+    watermark key; attach must carry those stamps into the re-keyed
+    wire ledger so the drain loop's retirement reaches them — left
+    behind, max_backlog_age() grows forever for a phantom stream."""
+    from gelly_tpu.engine.tenants import MultiTenantEngine
+    from gelly_tpu.ingest import TenantRouter
+    from gelly_tpu.library.connected_components import cc_tenant_tier
+
+    with obs.scope() as bus, obs.record_metrics():
+        agg, cap = cc_tenant_tier(N_V, chunk_capacity=16)
+        eng = MultiTenantEngine(merge_every=1).start()
+        router = TenantRouter(eng, "small", vertex_capacity=N_V)
+        eng.add_tier("small", agg, cap)
+        srv = _start_server()
+        try:
+            # DATA lands BEFORE attach: stamped under the default key.
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            rng = np.random.default_rng(17)
+            p = edge_payload(rng.integers(0, N_V, 8),
+                             rng.integers(0, N_V, 8))
+            p["tenant"] = np.array([5], np.int64)
+            cli.send(p)
+            cli.flush(timeout=30)
+            assert bus.watermarks.snapshot()["stream"]["pending"] == 1
+            router.attach(srv)
+            # The stamp moved with the key...
+            assert "stream" not in bus.watermarks.snapshot()
+            wire_key = srv.watermark_stream
+            # ...and the drain loop retires it as the frame routes.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if bus.watermarks.snapshot().get(
+                        wire_key, {}).get("pending") == 0:
+                    break
+                time.sleep(0.02)
+            assert bus.watermarks.snapshot()[wire_key]["pending"] == 0
+            assert bus.watermarks.max_backlog_age() == pytest.approx(
+                0.0, abs=60.0)  # sane, not a phantom epoch-sized age
+            cli.close(flush_timeout=None)
+        finally:
+            srv.stop()
+            router.stop()
+            eng.stop()
+
+
+def test_client_stats_rejects_straggler_reply():
+    """Regression: a straggler reply to an earlier TIMED-OUT stats()
+    call must not satisfy a later call with a stale snapshot — the
+    request token in the frame seq is matched on the reply."""
+    from gelly_tpu.ingest import wire
+
+    cli = IngestClient("127.0.0.1", 1)
+    sent: list = []
+
+    def fake_send(frame):
+        _ftype, seq, _len, _crc = wire.unpack_header(frame)
+        sent.append(seq)
+        # Deliver a STALE straggler synchronously (the reply the
+        # previous, timed-out request would have gotten)...
+        with cli._lock:
+            cli._stats_payload = b'{"which": "stale"}'
+            cli._stats_reply_token = seq - 1
+        cli._stats_evt.set()
+        # ...and the REAL reply shortly after, like the reader thread.
+
+        def late():
+            time.sleep(0.15)
+            with cli._lock:
+                cli._stats_payload = b'{"which": "fresh"}'
+                cli._stats_reply_token = seq
+            cli._stats_evt.set()
+
+        threading.Thread(target=late, daemon=True).start()
+
+    cli._raw_send = fake_send
+    got = cli.stats(timeout=5.0)
+    assert got == {"which": "fresh"}
+    assert len(sent) == 1
+
+    # A reply that never matches the token times out instead of
+    # returning stale data.
+    def stale_only(frame):
+        _ftype, seq, _len, _crc = wire.unpack_header(frame)
+        with cli._lock:
+            cli._stats_payload = b'{"which": "stale"}'
+            cli._stats_reply_token = seq - 1
+        cli._stats_evt.set()
+
+    cli._raw_send = stale_only
+    from gelly_tpu.ingest.client import IngestError
+
+    with pytest.raises(IngestError, match="no STATS reply"):
+        cli.stats(timeout=0.3)
+
+
+def test_tenant_submit_stamp_survives_mid_dispatch_submit():
+    """Regression: submit-side stamp positions used ``consumed +
+    len(queue)``, which under-counts by one inside the scheduler's
+    pop-queue→bump-consumed window (two separate lock acquisitions) —
+    a submit landing there collided with the previous chunk's stamp
+    and its e2e sample was silently dropped. Positions now come from a
+    monotonic per-tenant ``submitted`` counter."""
+    from gelly_tpu import edge_stream_from_edges
+    from gelly_tpu.engine.tenants import MultiTenantEngine
+    from gelly_tpu.library.connected_components import cc_tenant_tier
+
+    def chunks(seed, n=3):
+        rng = np.random.default_rng(seed)
+        stream = edge_stream_from_edges(
+            [(int(a), int(b)) for a, b in rng.integers(0, N_V, (n * 8, 2))],
+            vertex_capacity=N_V, chunk_size=8,
+        )
+        return list(stream)[:n]
+
+    with obs.scope() as bus, obs.record_metrics():
+        agg, cap = cc_tenant_tier(N_V, chunk_capacity=8)
+        eng = MultiTenantEngine(merge_every=1)  # scheduler NOT running
+        eng.add_tier("small", agg, cap)
+        eng.admit(7, "small")
+        c1, c2, c3 = chunks(31)
+        eng.submit(7, c1)
+        eng.submit(7, c2)
+        # Emulate the dispatch window: the chunk is popped but
+        # ``consumed`` has not been bumped yet.
+        with eng._lock:
+            eng._tenants[7].queue.popleft()
+        eng.submit(7, c3)  # must stamp position 2, not re-stamp 1
+        snap = bus.watermarks.snapshot()["7"]
+        assert snap["pending"] == 3, snap
+        assert snap["oldest_position"] == 0
+
+
+def test_sharded_provider_watermarks_fully_retire(tmp_path):
+    """Regression: provider unit seqs are lane-interleaved
+    (``local_unit * shards + shard``), so deriving stamp positions as
+    ``seq * batch`` overshot the positions retirement ever reaches —
+    after the run drained, the leaked stamps read as permanent
+    backlog. Provider-path stamps draw dense positions instead; the
+    ledger must be EMPTY once the stream completes, fresh and
+    resumed."""
+    from gelly_tpu.engine.checkpoint import load_checkpoint
+    from gelly_tpu.ingest import (
+        edge_stream_from_sharded_file,
+        write_binary_edges,
+    )
+    from gelly_tpu.library.connected_components import (
+        connected_components,
+    )
+
+    rng = np.random.default_rng(23)
+    src = rng.integers(0, N_V, 900)
+    dst = rng.integers(0, N_V, 900)
+    path = str(tmp_path / "edges.bin")
+    write_binary_edges(path, src, dst)
+
+    def agg_stream(ck, resume):
+        stream = edge_stream_from_sharded_file(path, N_V, shards=3,
+                                               chunk_size=64)
+        return stream.aggregate(
+            connected_components(N_V), merge_every=4, fold_batch=2,
+            source_provider=True, checkpoint_path=ck,
+            checkpoint_every=1, resume=resume,
+        )
+
+    # Fresh run to completion: the ledger must drain completely.
+    ck1 = str(tmp_path / "ck_fresh.npz")
+    with obs.scope() as bus, obs.record_metrics():
+        labels = np.asarray(agg_stream(ck1, resume=False).result())
+        snap = bus.watermarks.snapshot()["stream"]
+        assert snap["pending"] == 0, snap
+        assert bus.watermarks.backlog_age("stream") == 0.0
+        h = bus.snapshot()["histograms"]
+        # Every chunk's e2e latency was observed — none stranded.
+        assert h["engine.e2e_ingress_to_durable_ms"]["count"] == snap[
+            "base"] > 0
+
+    # Abandon a second run mid-stream, then resume: skip_until > 0
+    # must not re-offset the stamp positions.
+    ck2 = str(tmp_path / "ck_resume.npz")
+    it = iter(agg_stream(ck2, resume=False))
+    for _ in range(2):
+        next(it)
+    it.close()
+    _, pos, _ = load_checkpoint(ck2)
+    assert pos > 0
+    with obs.scope() as bus, obs.record_metrics():
+        labels2 = np.asarray(agg_stream(ck2, resume=True).result())
+        snap = bus.watermarks.snapshot()["stream"]
+        assert snap["pending"] == 0, snap
+        assert bus.watermarks.backlog_age("stream") == 0.0
+    np.testing.assert_array_equal(labels, labels2)
+
+
+def test_coordinated_runner_watermarks_fully_retire(tmp_path):
+    """Regression: the coordinated checkpoint path published epochs but
+    never retired the e2e ledger (the local ``_checkpoint`` did, and
+    the end-of-stream drain hid behind an ``elif`` the coordinator
+    branch shadowed) — with telemetry on, a healthy multi-host run
+    accumulated one stamp per chunk forever and backlog_age grew
+    without bound. Every barrier commit is a durability point: the
+    ledger must drain and the ingress→durable histogram populate."""
+    from gelly_tpu.engine.coordination import (
+        CoordinationConfig,
+        Coordinator,
+        HostIdentity,
+    )
+    from gelly_tpu.engine.resilience import (
+        ResilienceConfig,
+        ResilientRunner,
+    )
+
+    co = Coordinator(
+        str(tmp_path / "store"), HostIdentity(0, 1),
+        CoordinationConfig(lease_ttl=2.0, poll_s=0.005,
+                           barrier_timeout=10.0, lease_thread=False),
+    )
+    with obs.scope() as bus, obs.record_metrics():
+        r = ResilientRunner(
+            lambda s, c: (s + np.int64(c), None), list(range(10)),
+            np.int64(0), coordinator=co,
+            config=ResilienceConfig(checkpoint_every_chunks=4,
+                                    watchdog_timeout=30.0),
+        )
+        assert int(r.run()) == sum(range(10))
+        assert r.stats["checkpoints"] == 3  # 4, 8, final 10
+        snap = bus.watermarks.snapshot()["stream"]
+        assert snap["pending"] == 0, snap
+        assert snap["base"] == 10
+        assert bus.watermarks.backlog_age("stream") == 0.0
+        h = bus.snapshot()["histograms"]
+        assert h["resilience.e2e_ingress_to_durable_ms"]["count"] == 10
+        assert bus.gauges["engine.backlog_age_s"] == 0.0
+
+
+# --------------------------------------------------------------------- #
+# the headline: STATS interleaved with DATA + SIGKILL exactly-once +
+# watermark correctness across resume (slow; CI obs lane)
+
+
+def _spawn_child(ckpt, port_file, out, sleep_s):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(ckpt), str(port_file), str(out),
+         str(sleep_s)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_port(port_file, proc, timeout=180):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"child exited rc={proc.returncode} before publishing "
+                "its port"
+            )
+        if os.path.exists(port_file):
+            return int(open(port_file).read())
+        time.sleep(0.02)
+    raise AssertionError("child never published its port")
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_stats_mid_stream_sigkill_exactly_once_and_watermarks(tmp_path):
+    import _telemetry_crash_child as child_mod
+
+    rng = np.random.default_rng(41)
+    total = 32  # multiple of the child's merge window
+    payloads = [
+        edge_payload(rng.integers(0, child_mod.N_V, child_mod.CHUNK),
+                     rng.integers(0, child_mod.N_V, child_mod.CHUNK))
+        for _ in range(total)
+    ]
+    # Degrees oracle: every edge bumps out-deg[src] and in-deg[dst]
+    # (the ±1 scatter is non-idempotent — a double-folded acked chunk
+    # is visible in the final vector).
+    golden = np.zeros(child_mod.N_V, dtype=np.int64)
+    for p in payloads:
+        golden += np.bincount(p["src"], minlength=child_mod.N_V)
+        golden += np.bincount(p["dst"], minlength=child_mod.N_V)
+
+    ckpt = str(tmp_path / "ck.npz")
+    port_file = str(tmp_path / "port")
+    out = str(tmp_path / "final.npz")
+
+    p1 = _spawn_child(ckpt, port_file, out, 0.05)
+    port = _wait_port(port_file, p1)
+    cli = IngestClient("127.0.0.1", port, send_pause_timeout=60)
+    cli.connect()
+
+    sent = 0
+    stats_seen: list = []
+
+    def sender():
+        nonlocal sent
+        from gelly_tpu.ingest.client import IngestError
+
+        while sent < total:
+            try:
+                cli.send(payloads[sent])
+                sent += 1
+            except IngestError:
+                sent += 1  # buffered; reconnect() delivers it
+                return
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+
+    # Interleave STATS with the live DATA stream (dedicated conn) and
+    # hold the acceptance bar on the reply: valid JSON, per-stream
+    # backlog watermark, and p50/p99 for the three named histograms.
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if cli.acked >= 4:
+            st = fetch_stats("127.0.0.1", port, timeout=10)
+            stats_seen.append(st)
+            hists = st["histograms"]
+            if ("engine.fold_dispatch_ms" in hists
+                    and "engine.checkpoint_write_ms" in hists
+                    and "ingest.receive_to_stage_ms" in hists):
+                break
+        time.sleep(0.05)
+    else:
+        pytest.fail("histograms never appeared in mid-stream STATS")
+    st = stats_seen[-1]
+    for name in ("engine.fold_dispatch_ms", "engine.checkpoint_write_ms",
+                 "ingest.receive_to_stage_ms"):
+        h = st["histograms"][name]
+        assert h["count"] >= 1
+        assert h["p50"] >= 0.0 and h["p99"] >= h["p50"] >= 0.0
+    assert "stream" in st["watermarks"]
+    assert st["watermarks"]["stream"]["backlog_age_s"] >= 0.0
+    assert st["server"]["auto_ack"] is False
+
+    # SIGKILL mid-stream, with acked-but-unsent work outstanding.
+    acked_before_kill = cli.acked
+    assert acked_before_kill < total
+    os.kill(p1.pid, signal.SIGKILL)
+    assert p1.wait(timeout=60) == -signal.SIGKILL
+    assert not os.path.exists(out)
+    t.join(timeout=60)
+
+    # Restart: the new incarnation resumes at its newest checkpoint —
+    # the STATS interleaving above must not have perturbed sequencing.
+    os.unlink(port_file)
+    p2 = _spawn_child(ckpt, port_file, out, 0.0)
+    cli.port = _wait_port(port_file, p2)
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            cli.reconnect()
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+    assert cli.acked >= acked_before_kill  # acked work never rewinds
+    while sent < total:
+        cli.send(payloads[sent])
+        sent += 1
+    cli.flush(timeout=180)
+    cli.close()
+    assert p2.wait(timeout=300) == 0
+
+    from gelly_tpu.engine.checkpoint import load_checkpoint
+
+    final, pos, meta = load_checkpoint(out)
+    # Flat leaves arrive in sorted-key order: ages, degrees, oldest.
+    ages, degrees, oldest = final
+    assert pos == total
+    # THE exactly-once assertion: the non-idempotent degree vector is
+    # bit-identical to the oracle — no acked chunk double-folded, no
+    # chunk lost, STATS notwithstanding.
+    np.testing.assert_array_equal(np.asarray(degrees), golden)
+    # Watermark correctness across the SIGKILL: no negative and no
+    # wall-clock-sized (time-travelling) backlog age, in either
+    # incarnation's samples.
+    assert np.all(np.asarray(ages) >= 0.0)
+    assert np.all(np.asarray(ages) < 600.0)
+    # The resumed incarnation re-seeded from the RESUMED POSITION: its
+    # samples never report a pending stamp below it.
+    assert meta["resumed"] is True
+    resume_pos = int(meta["resume_pos"])
+    assert resume_pos >= acked_before_kill
+    sampled = np.asarray(oldest)
+    sampled = sampled[sampled >= 0]
+    if sampled.size:
+        assert int(sampled.min()) >= resume_pos
