@@ -30,17 +30,30 @@ correctness-tooling floor under both:
   trims), and observability-drift rules (``OB0xx`` — the ``obs/bus.py``
   glossary must match the package's emitted names exactly), same
   suppression machinery;
+- :mod:`gelly_tpu.analysis.plancheck` — compiled-plan contract checker
+  (``PC0xx``): cache-key completeness of the memoizing plan builders
+  (``PC1xx`` — the typo'd-``merge_mode`` bug class), donation/aliasing
+  discipline across the vmapped tenant stack and the fused executor
+  (``PC2xx``), masked-lane bit-invariance (``PC3xx``), and the
+  declarative eligibility refusal matrix over every plan entry point
+  (``PC4xx``), same suppression machinery;
+- :mod:`gelly_tpu.analysis.loader` — the shared single-parse AST cache
+  every tool reads through (one ``ast.parse`` per file per CLI
+  invocation; unparseable files are loud per-file ``SRC001``
+  diagnostics from every covering tool);
 - :mod:`gelly_tpu.analysis.sanitize` — builds the native components
   under ASan/UBSan (``GELLY_NATIVE_SANITIZE=asan|ubsan``) and drives a
   smoke workload through every fold in an ``LD_PRELOAD``-prepared
   subprocess.
 
 Run everything with ``python -m gelly_tpu.analysis`` (or one tool via
-``python -m gelly_tpu.analysis abi|jitlint|racecheck|contracts
-[paths]``); the
-exit code is non-zero iff any unsuppressed finding exists, and
-``--format=json`` emits the findings machine-readably for CI. See
-``--help`` for lane selection.
+``python -m gelly_tpu.analysis
+abi|jitlint|racecheck|contracts|plancheck [paths]``); the
+exit code is non-zero iff any unsuppressed finding exists,
+``--format=json`` emits the findings machine-readably for CI,
+``--format=github`` emits inline PR workflow annotations, and
+``--changed[=REF]`` scopes reporting to files differing from a git
+ref. See ``--help`` for lane selection.
 """
 
 from __future__ import annotations

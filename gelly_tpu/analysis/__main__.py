@@ -6,6 +6,7 @@ Unified exit-code contract for every analysis tool:
     python -m gelly_tpu.analysis --all            # same, explicit
     python -m gelly_tpu.analysis racecheck PATH…  # one tool, optional paths
     python -m gelly_tpu.analysis contracts PATH…
+    python -m gelly_tpu.analysis plancheck PATH…
     python -m gelly_tpu.analysis jitlint
     python -m gelly_tpu.analysis abi
 
@@ -14,6 +15,18 @@ summary follows, and the exit code is non-zero **iff any unsuppressed
 finding exists** (suppressed lines never reach the output). This is the
 gate every PR inherits (.github/workflows/analysis.yml); run it locally
 before pushing native, jit, or threaded-runtime changes.
+
+Every tool shares ONE parsed-AST cache per invocation
+(``analysis/loader.py``): each file is read and ``ast.parse``-d once,
+however many tools cover it, and an unparseable file (syntax error,
+non-UTF8 bytes, zero-byte truncation) is a loud per-file ``SRC001``
+finding from every covering tool — never a crash, never a silent skip.
+
+``--changed[=REF]`` lints only files that differ vs a git ref (default
+``HEAD``) plus untracked files — the pre-commit/CI fast path. Tools
+whose rules are whole-package (racecheck lock cycles, the OB glossary,
+the plancheck PC4xx matrix) still LOAD the full lint set but only
+REPORT findings anchored in changed files.
 
 ``--format=json`` emits a machine-readable object for CI consumption::
 
@@ -24,6 +37,10 @@ before pushing native, jit, or threaded-runtime changes.
                     "message": "...", "hint": "..."}]}},
      "total": 1, "ok": false}
 
+``--format=github`` emits one GitHub Actions workflow annotation per
+finding (``::error file=…,line=…,title=RULE::message``) so CI findings
+render inline on the PR diff; the exit-code contract is unchanged.
+
 The sanitizer smoke lane rides along via ``--sanitize asan|ubsan|both``
 (orthogonal to the finding tools; its failures also drive the exit code).
 """
@@ -33,19 +50,22 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
-from . import Finding
+from . import Finding, collect_python_files
 from . import abi as abi_mod
 from . import contracts as contracts_mod
 from . import jitlint as jitlint_mod
+from . import loader as loader_mod
+from . import plancheck as plancheck_mod
 from . import racecheck as racecheck_mod
 from . import sanitize as sanitize_mod
 
 _REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", ".."))
 
-TOOLS = ("abi", "jitlint", "racecheck", "contracts")
+TOOLS = ("abi", "jitlint", "racecheck", "contracts", "plancheck")
 
 
 def _list_rules() -> str:
@@ -73,9 +93,62 @@ def _list_rules() -> str:
                  "`OBxxx`:")
     for rid, (summary, _hint) in sorted(contracts_mod.RULES.items()):
         lines.append(f"  {rid}  {summary}")
+    lines.append("compiled-plan contract checker (analysis/plancheck.py), "
+                 "suppress with `# graphlint: disable=PCxxx`:")
+    for rid, (summary, _hint) in sorted(plancheck_mod.RULES.items()):
+        lines.append(f"  {rid}  {summary}")
+    lines.append("shared source loader (analysis/loader.py):")
+    lines.append(f"  {loader_mod.SRC_RULE}  {loader_mod.SRC_SUMMARY} "
+                 "(syntax error / non-UTF8 / zero-byte; emitted by every "
+                 "covering tool, not suppressible)")
     lines.append("sanitizer lane (analysis/sanitize.py): "
                  "--sanitize asan|ubsan, env GELLY_NATIVE_SANITIZE")
     return "\n".join(lines)
+
+
+def _github_annotation(f: Finding, root: str) -> str:
+    """One ``::error`` workflow command per finding. GitHub parses the
+    message up to the first newline; data is %-escaped per the
+    workflow-command spec — property values (``file=``/``title=``)
+    additionally escape ``:`` and ``,``, the property delimiters."""
+    def esc(s: str) -> str:
+        return (s.replace("%", "%25").replace("\r", "%0D")
+                .replace("\n", "%0A"))
+
+    def esc_prop(s: str) -> str:
+        return esc(s).replace(":", "%3A").replace(",", "%2C")
+
+    path = os.path.relpath(f.path, root)
+    if path.startswith(".."):
+        path = f.path
+    msg = f.message + (f" | hint: {f.hint}" if f.hint else "")
+    return (f"::error file={esc_prop(path)},line={f.line},"
+            f"title={esc_prop(f.rule)}::{esc(msg)}")
+
+
+def _changed_files(root: str, ref: str) -> set:
+    """Absolute paths of files differing from ``ref`` (worktree diff)
+    plus untracked files — the ``--changed`` lint scope."""
+    def run(*args):
+        p = subprocess.run(["git", "-C", root, *args],
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise SystemExit(
+                f"--changed: git {' '.join(args)} failed: "
+                f"{p.stderr.strip() or p.stdout.strip()}")
+        return [ln for ln in p.stdout.splitlines() if ln.strip()]
+
+    # `git diff --name-only` prints TOPLEVEL-relative paths while
+    # `ls-files --others` prints cwd-relative ones — join each against
+    # its own base or a --root below the toplevel resolves tracked
+    # changes to nonexistent paths (and silently reports clean).
+    top = run("rev-parse", "--show-toplevel")
+    diff_base = top[0] if top else root
+    out = {os.path.abspath(os.path.join(diff_base, n))
+           for n in run("diff", "--name-only", ref, "--")}
+    out |= {os.path.abspath(os.path.join(root, n))
+            for n in run("ls-files", "--others", "--exclude-standard")}
+    return out
 
 
 def _finding_dict(f: Finding) -> dict:
@@ -85,6 +158,27 @@ def _finding_dict(f: Finding) -> dict:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # `--changed [REF]` normalizes to `--changed=REF` BEFORE argparse so
+    # an nargs="?" flag can never swallow a following tool/path token:
+    # the next token is taken as the REF only when it cannot be a tool
+    # name, a flag, or an existing lint path (prefer the unambiguous
+    # `--changed=REF` spelling when a ref shadows a path).
+    norm = []
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok == "--changed":
+            nxt = argv[i + 1] if i + 1 < len(argv) else None
+            if nxt is not None and not nxt.startswith("-") \
+                    and nxt not in TOOLS + ("all",) \
+                    and not os.path.exists(nxt):
+                norm.append(f"--changed={nxt}")
+                i += 2
+                continue
+            tok = "--changed=HEAD"
+        norm.append(tok)
+        i += 1
+    argv = norm
     # Subcommand form: the FIRST positional token naming a tool (or
     # "all") selects it — flags may come before it (`--format=json
     # racecheck gelly_tpu/` works like `racecheck --format=json ...`).
@@ -111,19 +205,20 @@ def main(argv=None) -> int:
         prog="python -m gelly_tpu.analysis",
         description="repo-specific static analysis: ABI cross-check of "
                     "native/*.cc vs ctypes bindings, jit-hazard lint, "
-                    "concurrency race/protocol-invariant check and "
-                    "durability/wire/observability contract check of "
-                    "gelly_tpu/, optional native sanitizer smoke lane. "
+                    "concurrency race/protocol-invariant check, "
+                    "durability/wire/observability contract check and "
+                    "compiled-plan contract check of gelly_tpu/, "
+                    "optional native sanitizer smoke lane. "
                     "Subcommands: abi | jitlint | racecheck | contracts "
-                    "| all (default all).",
+                    "| plancheck | all (default all).",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (jitlint + racecheck + "
-                         "contracts; default ROOT/gelly_tpu)")
+                         "contracts + plancheck; default ROOT/gelly_tpu)")
     ap.add_argument("--all", action="store_true",
                     help="run every tool (abi+jitlint+racecheck+"
-                         "contracts) — the default when no subcommand "
-                         "is given")
+                         "contracts+plancheck) — the default when no "
+                         "subcommand is given")
     ap.add_argument("--root", default=_REPO_ROOT,
                     help="repo root (default: the checkout this package "
                          "lives in)")
@@ -144,9 +239,19 @@ def main(argv=None) -> int:
                     help="skip the concurrency race detector")
     ap.add_argument("--skip-contracts", action="store_true",
                     help="skip the durability-contract checker")
-    ap.add_argument("--format", choices=("text", "json"), default="text",
+    ap.add_argument("--skip-plancheck", action="store_true",
+                    help="skip the compiled-plan contract checker")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only files that differ vs the given git "
+                         "ref (default HEAD) plus untracked files; "
+                         "whole-package rules still load the full set "
+                         "but report only changed-file findings")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text",
                     help="output format (json: one machine-readable "
-                         "object on stdout, for CI)")
+                         "object on stdout, for CI; github: workflow "
+                         "::error annotations for inline PR display)")
     ap.add_argument("--sanitize", choices=("asan", "ubsan", "both"),
                     default=None,
                     help="also run the native smoke workload under the "
@@ -177,16 +282,51 @@ def main(argv=None) -> int:
         run["racecheck"] = False
     if args.skip_contracts:
         run["contracts"] = False
+    if args.skip_plancheck:
+        run["plancheck"] = False
+
+    changed = None
+    if args.changed is not None:
+        changed = _changed_files(root, args.changed)
+
+    # One parsed-AST cache per invocation: every tool below reads the
+    # same tree objects, so --all parses each file once, not five times.
+    cache = loader_mod.SourceCache()
+    # jitlint's rules are per-file, so --changed narrows its INPUT (the
+    # fast path); the whole-package tools keep the full lint set loaded
+    # and are post-filtered to changed-file anchors below.
+    jit_inputs = lint_paths
+    if changed is not None:
+        jit_inputs = [f for f in collect_python_files(lint_paths)
+                      if f in changed]
 
     per_tool: dict[str, list[Finding]] = {}
     if run["abi"]:
-        per_tool["abi"] = abi_mod.cross_check(native_dir, bindings)
+        per_tool["abi"] = abi_mod.cross_check(native_dir, bindings,
+                                              cache=cache)
     if run["jitlint"]:
-        per_tool["jitlint"] = jitlint_mod.lint_paths(root, lint_paths)
+        per_tool["jitlint"] = jitlint_mod.lint_paths(root, jit_inputs,
+                                                     cache=cache)
     if run["racecheck"]:
-        per_tool["racecheck"] = racecheck_mod.lint_paths(root, lint_paths)
+        per_tool["racecheck"] = racecheck_mod.lint_paths(root, lint_paths,
+                                                         cache=cache)
     if run["contracts"]:
-        per_tool["contracts"] = contracts_mod.lint_paths(root, lint_paths)
+        per_tool["contracts"] = contracts_mod.lint_paths(root, lint_paths,
+                                                         cache=cache)
+    if run["plancheck"]:
+        per_tool["plancheck"] = plancheck_mod.lint_paths(root, lint_paths,
+                                                         cache=cache)
+
+    if changed is not None:
+        # SRC001 is exempt from the changed-file scope: an unparseable
+        # file ANYWHERE in the set means the whole-package rules ran
+        # blind, so the fast path must not report "clean" over it.
+        per_tool = {
+            t: [f for f in fs
+                if f.rule == loader_mod.SRC_RULE
+                or os.path.abspath(f.path) in changed]
+            for t, fs in per_tool.items()
+        }
 
     findings = [f for fs in per_tool.values() for f in fs]
     rc = 1 if findings else 0
@@ -211,6 +351,16 @@ def main(argv=None) -> int:
             else:
                 sanitize_lines.append(
                     proc.stdout.strip() or f"sanitize[{mode}]: clean")
+
+    if args.format == "github":
+        for f in findings:
+            print(_github_annotation(f, root))
+        for t, fs in per_tool.items():
+            print(f"{t}: {len(fs)} finding(s)",
+                  file=sys.stderr if fs else sys.stdout)
+        for line in sanitize_lines:
+            print(line, file=sys.stderr if rc else sys.stdout)
+        return rc
 
     if args.format == "json":
         print(json.dumps({

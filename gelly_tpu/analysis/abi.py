@@ -311,15 +311,25 @@ def _resolve_ctype(node: ast.AST, env: dict):
     return None
 
 
-def parse_ctypes_bindings(path: str):
+def parse_ctypes_bindings(path: str, cache=None):
     """All ``<lib>.<name>.argtypes/.restype`` assignments in a module.
 
     Returns ``(bindings, findings)`` where bindings maps symbol name →
     :class:`Binding`. Module-level aliases (``_i32p = ctypes.POINTER(...)``)
-    are resolved first so binding lists can use them.
+    are resolved first so binding lists can use them. An unparseable
+    bindings module is a loud SRC001 finding, never a crash (the
+    shared :class:`~gelly_tpu.analysis.loader.SourceCache` contract).
     """
-    with open(path, "r", encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
+    from .loader import SourceCache
+
+    cache = cache or SourceCache()
+    ms = cache.get(path)
+    if ms is None:
+        err = cache.error(path)
+        f = err.finding() if err is not None else Finding(
+            path, 1, "SRC001", "bindings module could not be parsed")
+        return {}, [f]
+    tree = ms.tree
     env: dict = {}
     for node in tree.body:
         if (isinstance(node, ast.Assign) and len(node.targets) == 1
@@ -380,9 +390,11 @@ def _types_match(c_type: str, py_type: str) -> bool:
     return c_type == py_type
 
 
-def cross_check(native_dir: str, bindings_path: str) -> list[Finding]:
+def cross_check(native_dir: str, bindings_path: str,
+                cache=None) -> list[Finding]:
     """Diff every ``extern "C"`` declaration under ``native_dir`` against
-    the ctypes bindings in ``bindings_path``."""
+    the ctypes bindings in ``bindings_path``. ``cache`` optionally
+    shares the CLI-wide parsed-source cache for the bindings module."""
     findings: list[Finding] = []
     decls: dict[str, CDecl] = {}
     for cc in sorted(glob.glob(os.path.join(native_dir, "*.cc"))):
@@ -396,7 +408,7 @@ def cross_check(native_dir: str, bindings_path: str) -> list[Finding]:
                     f"(also in {decls[d.name].path})",
                 ))
             decls[d.name] = d
-    bindings, fs = parse_ctypes_bindings(bindings_path)
+    bindings, fs = parse_ctypes_bindings(bindings_path, cache=cache)
     findings.extend(fs)
 
     for name, d in sorted(decls.items()):

@@ -311,29 +311,36 @@ def _iter_scopes(tree: ast.Module):
 class ContractChecker:
     """Whole-package durability/wire/observability contract lint."""
 
-    def __init__(self, package_root: str):
+    def __init__(self, package_root: str, cache=None):
+        from .loader import SourceCache
+
         self.package_root = os.path.abspath(package_root)
         self.findings: list[Finding] = []
         self._modules: dict[str, _Mod] = {}
+        self._cache = cache or SourceCache()
         # OB state, accumulated across every linted module.
         self._glossary: dict[str, tuple[int, _Mod]] = {}  # name -> line
         self._emits: list[_EmitSite] = []
 
     # ------------------------------------------------------------ loading
 
-    def load(self, path: str) -> _Mod:
+    def load(self, path: str) -> _Mod | None:
         path = os.path.abspath(path)
         if path in self._modules:
             return self._modules[path]
-        with open(path, "r", encoding="utf-8") as f:
-            src = f.read()
-        m = _Mod(path=path, tree=ast.parse(src, filename=path),
-                 lines=src.splitlines())
+        ms = self._cache.get(path)
+        if ms is None:
+            return None
+        m = _Mod(path=path, tree=ms.tree, lines=ms.lines)
         self._modules[path] = m
         return m
 
     def lint_paths(self, paths) -> list[Finding]:
-        mods = [self.load(f) for f in collect_python_files(paths)]
+        mods = []
+        for f in collect_python_files(paths):
+            if self._cache.get_or_finding(f, self.findings) is None:
+                continue
+            mods.append(self.load(f))
         for m in mods:
             if os.path.basename(m.path) == "bus.py":
                 self._load_glossary(m)
@@ -936,8 +943,9 @@ class ContractChecker:
                 )
 
 
-def lint_paths(package_root: str, paths) -> list[Finding]:
+def lint_paths(package_root: str, paths, cache=None) -> list[Finding]:
     """Convenience wrapper mirroring :func:`jitlint.lint_paths` /
     :func:`racecheck.lint_paths`: run a fresh :class:`ContractChecker`
-    over ``paths``."""
-    return ContractChecker(package_root).lint_paths(paths)
+    over ``paths``, optionally sharing a parsed
+    :class:`~gelly_tpu.analysis.loader.SourceCache`."""
+    return ContractChecker(package_root, cache=cache).lint_paths(paths)

@@ -231,10 +231,13 @@ class _Module:
 class JitLinter:
     """Lints a set of Python files; loads cross-module callees lazily."""
 
-    def __init__(self, package_root: str):
+    def __init__(self, package_root: str, cache=None):
         # package_root is the directory CONTAINING the gelly_tpu package.
+        from .loader import SourceCache
+
         self.package_root = os.path.abspath(package_root)
         self._modules: dict[str, _Module] = {}
+        self._cache = cache or SourceCache()
         self._visited: set = set()
         self.findings: list[Finding] = []
 
@@ -256,15 +259,19 @@ class JitLinter:
         return None
 
     def load(self, path: str):
+        """The derived module info, or None when the source is
+        unparseable (recorded in the shared cache; ``lint_file``
+        surfaces it as a SRC001 finding for in-set files)."""
         path = os.path.abspath(path)
         if path in self._modules:
             return self._modules[path]
-        with open(path, "r", encoding="utf-8") as f:
-            src = f.read()
-        tree = ast.parse(src, filename=path)
+        ms = self._cache.get(path)
+        if ms is None:
+            return None
+        tree = ms.tree
         m = _Module(
             path=path, dotted=self._dotted_name(path), tree=tree,
-            lines=src.splitlines(), numpy_aliases=set(), jnp_aliases=set(),
+            lines=ms.lines, numpy_aliases=set(), jnp_aliases=set(),
             jax_aliases=set(), time_aliases=set(), datetime_aliases=set(),
             clock_names=set(),
             pallas_aliases=set(), pallas_call_names=set(),
@@ -428,6 +435,8 @@ class JitLinter:
         return self.findings
 
     def lint_file(self, path: str) -> None:
+        if self._cache.get_or_finding(path, self.findings) is None:
+            return
         m = self.load(path)
         for fn in m.all_functions:
             jitted, statics, nums = self._jit_decoration(m, fn)
@@ -635,6 +644,8 @@ class JitLinter:
             if func.id in m.from_functions:
                 path, name = m.from_functions[func.id]
                 mod = self.load(path)
+                if mod is None:
+                    return None
                 fn = mod.functions.get(name)
                 return (mod, fn) if fn is not None else None
             fn = m.functions.get(func.id)
@@ -642,6 +653,8 @@ class JitLinter:
         chain = _attr_chain(func)
         if chain and len(chain) == 2 and chain[0] in m.module_aliases:
             mod = self.load(m.module_aliases[chain[0]])
+            if mod is None:
+                return None
             fn = mod.functions.get(chain[1])
             return (mod, fn) if fn is not None else None
         return None
@@ -1099,7 +1112,9 @@ class _DonationLint:
                 donated[tgt] = donated[stmt.value.id]
 
 
-def lint_paths(package_root: str, paths) -> list[Finding]:
+def lint_paths(package_root: str, paths, cache=None) -> list[Finding]:
     """Convenience wrapper: lint ``paths`` with a fresh :class:`JitLinter`
-    rooted at ``package_root`` (the directory containing ``gelly_tpu``)."""
-    return JitLinter(package_root).lint_paths(paths)
+    rooted at ``package_root`` (the directory containing ``gelly_tpu``),
+    optionally sharing a parsed
+    :class:`~gelly_tpu.analysis.loader.SourceCache`."""
+    return JitLinter(package_root, cache=cache).lint_paths(paths)

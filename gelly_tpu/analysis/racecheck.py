@@ -312,10 +312,13 @@ def _local_defs(fn: ast.AST):
 class RaceChecker:
     """Whole-package concurrency analysis over a set of Python files."""
 
-    def __init__(self, package_root: str):
+    def __init__(self, package_root: str, cache=None):
+        from .loader import SourceCache
+
         self.package_root = os.path.abspath(package_root)
         self.findings: list[Finding] = []
         self._modules: dict[str, _Mod] = {}
+        self._cache = cache or SourceCache()
         self.roots: list[_Root] = []
         # (cls_key, attr) -> [_Access]
         self.accesses: dict = {}
@@ -338,15 +341,16 @@ class RaceChecker:
         rel = rel[:-3] if rel.endswith(".py") else rel
         return ".".join(p for p in rel.split(os.sep) if p != ".")
 
-    def load(self, path: str) -> _Mod:
+    def load(self, path: str) -> _Mod | None:
         path = os.path.abspath(path)
         if path in self._modules:
             return self._modules[path]
-        with open(path, "r", encoding="utf-8") as f:
-            src = f.read()
-        tree = ast.parse(src, filename=path)
+        ms = self._cache.get(path)
+        if ms is None:
+            return None
+        tree = ms.tree
         m = _Mod(path=path, base=self._dotted(path),
-                 tree=tree, lines=src.splitlines())
+                 tree=tree, lines=ms.lines)
         for node in tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 m.functions[node.name] = node
@@ -778,7 +782,11 @@ class RaceChecker:
     # ----------------------------------------------------------- linting
 
     def lint_paths(self, paths) -> list[Finding]:
-        mods = [self.load(f) for f in collect_python_files(paths)]
+        mods = []
+        for f in collect_python_files(paths):
+            if self._cache.get_or_finding(f, self.findings) is None:
+                continue
+            mods.append(self.load(f))
         for m in mods:
             self._discover_roots(m)
         self._rc006: list = []
@@ -1172,8 +1180,9 @@ def _ck_confined_lease_write(index, inv, emit) -> None:
              "writes lease files")
 
 
-def lint_paths(package_root: str, paths) -> list[Finding]:
+def lint_paths(package_root: str, paths, cache=None) -> list[Finding]:
     """Convenience wrapper mirroring :func:`jitlint.lint_paths`: run a
     fresh :class:`RaceChecker` (race rules + protocol invariants for any
-    ``coordination.py`` in the set) over ``paths``."""
-    return RaceChecker(package_root).lint_paths(paths)
+    ``coordination.py`` in the set) over ``paths``, optionally sharing a
+    parsed :class:`~gelly_tpu.analysis.loader.SourceCache`."""
+    return RaceChecker(package_root, cache=cache).lint_paths(paths)

@@ -453,8 +453,14 @@ def _compiled_plan(agg: SummaryAggregation, m):
     # closures on every run_aggregation call would recompile the whole plan
     # each time (~10s/program over the TPU tunnel). Storing on the instance
     # ties the cache (and its compiled executables) to the agg's lifetime.
+    # EVERY scalar knob this builder reads must appear in the key (the
+    # plancheck PC101 contract): a knob read but not keyed means mutating
+    # it on a live instance silently returns the stale compiled plan.
     key = (tuple(d.id for d in m.devices.flat), m.axis_names,
-           agg.fold_backend, agg.merge_mode)
+           agg.fold_backend, agg.merge_mode, agg.merge_degree,
+           agg.merge_delta_auto_rows, agg.transient,
+           agg.fold_accumulates, agg.transform_may_alias,
+           agg.jit_transform)
     per_agg = agg.__dict__.setdefault("_plan_cache", {})
     if key in per_agg:
         return per_agg[key]

@@ -822,7 +822,8 @@ def test_cli_skip_contracts(capsys):
                         "--format=json"])
     payload = json.loads(capsys.readouterr().out)
     assert rc == 0
-    assert set(payload["tools"]) == {"abi", "jitlint", "racecheck"}
+    assert set(payload["tools"]) == {"abi", "jitlint", "racecheck",
+                                     "plancheck"}
 
 
 def test_cli_list_rules_includes_contract_rules(capsys):
