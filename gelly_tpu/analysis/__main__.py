@@ -7,8 +7,10 @@ Unified exit-code contract for every analysis tool:
     python -m gelly_tpu.analysis racecheck PATH…  # one tool, optional paths
     python -m gelly_tpu.analysis contracts PATH…
     python -m gelly_tpu.analysis plancheck PATH…
+    python -m gelly_tpu.analysis liveness PATH…
     python -m gelly_tpu.analysis jitlint
     python -m gelly_tpu.analysis abi
+    python -m gelly_tpu.analysis suppressions   # audit the disables
 
 Findings print as ``path:line: RULE message``; a per-tool finding-count
 summary follows, and the exit code is non-zero **iff any unsuppressed
@@ -40,6 +42,15 @@ REPORT findings anchored in changed files.
 ``--format=github`` emits one GitHub Actions workflow annotation per
 finding (``::error file=…,line=…,title=RULE::message``) so CI findings
 render inline on the PR diff; the exit-code contract is unchanged.
+``--format=sarif`` emits one SARIF 2.1.0 document covering every tool
+that ran (rule metadata included) for
+``github/codeql-action/upload-sarif``.
+
+The ``suppressions`` subcommand audits every ``# graphlint: disable=``
+directive (justification present, rule id known, rule still firing at
+the anchor — see analysis/suppressions.py) with the standard exit-code
+contract; under ``--all`` the same audit rides along as warnings that
+never flip the exit code.
 
 The sanitizer smoke lane rides along via ``--sanitize asan|ubsan|both``
 (orthogonal to the finding tools; its failures also drive the exit code).
@@ -57,27 +68,38 @@ from . import Finding, collect_python_files
 from . import abi as abi_mod
 from . import contracts as contracts_mod
 from . import jitlint as jitlint_mod
+from . import liveness as liveness_mod
 from . import loader as loader_mod
 from . import plancheck as plancheck_mod
 from . import racecheck as racecheck_mod
 from . import sanitize as sanitize_mod
+from . import suppressions as suppressions_mod
 
 _REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", ".."))
 
-TOOLS = ("abi", "jitlint", "racecheck", "contracts", "plancheck")
+TOOLS = ("abi", "jitlint", "racecheck", "contracts", "plancheck",
+         "liveness")
+
+# "suppressions" is a subcommand but NOT a member of TOOLS: in --all it
+# rides along as warnings that never flip the exit code, so the finding
+# gate and the hygiene gate stay independently readable (CI gates on
+# the dedicated lane).
+SUBCOMMANDS = TOOLS + ("all", "suppressions")
+
+_AB_RULES = (
+    ("AB001", "native function has no ctypes binding"),
+    ("AB002", "binding names a symbol no extern \"C\" block declares"),
+    ("AB003", "parameter-count (arity) mismatch"),
+    ("AB004", "parameter type/width mismatch"),
+    ("AB005", "return type mismatch / missing restype or argtypes"),
+    ("AB006", "declaration or binding the checker cannot resolve"),
+)
 
 
 def _list_rules() -> str:
     lines = ["ABI cross-checker (analysis/abi.py):"]
-    for rid, desc in (
-        ("AB001", "native function has no ctypes binding"),
-        ("AB002", "binding names a symbol no extern \"C\" block declares"),
-        ("AB003", "parameter-count (arity) mismatch"),
-        ("AB004", "parameter type/width mismatch"),
-        ("AB005", "return type mismatch / missing restype or argtypes"),
-        ("AB006", "declaration or binding the checker cannot resolve"),
-    ):
+    for rid, desc in _AB_RULES:
         lines.append(f"  {rid}  {desc}")
     lines.append("jit-hazard linter (analysis/jitlint.py), suppress with "
                  "`# graphlint: disable=GLxxx`:")
@@ -97,6 +119,15 @@ def _list_rules() -> str:
                  "suppress with `# graphlint: disable=PCxxx`:")
     for rid, (summary, _hint) in sorted(plancheck_mod.RULES.items()):
         lines.append(f"  {rid}  {summary}")
+    lines.append("liveness & progress checker (analysis/liveness.py), "
+                 "suppress with `# graphlint: disable=LVxxx`:")
+    for rid, (summary, _hint) in sorted(liveness_mod.RULES.items()):
+        lines.append(f"  {rid}  {summary}")
+    lines.append("suppression audit (analysis/suppressions.py), "
+                 "dedicated `suppressions` subcommand; SUP findings are "
+                 "not suppressible:")
+    for rid, (summary, _hint) in sorted(suppressions_mod.RULES.items()):
+        lines.append(f"  {rid}  {summary}")
     lines.append("shared source loader (analysis/loader.py):")
     lines.append(f"  {loader_mod.SRC_RULE}  {loader_mod.SRC_SUMMARY} "
                  "(syntax error / non-UTF8 / zero-byte; emitted by every "
@@ -106,11 +137,79 @@ def _list_rules() -> str:
     return "\n".join(lines)
 
 
-def _github_annotation(f: Finding, root: str) -> str:
-    """One ``::error`` workflow command per finding. GitHub parses the
-    message up to the first newline; data is %-escaped per the
-    workflow-command spec — property values (``file=``/``title=``)
-    additionally escape ``:`` and ``,``, the property delimiters."""
+def _rule_metadata() -> list:
+    """Every rule id across every tool with its summary/hint — the
+    SARIF ``tool.driver.rules`` array (and the machine-readable twin of
+    ``--list-rules``)."""
+    rules: dict = {rid: (desc, "") for rid, desc in _AB_RULES}
+    for mod in (jitlint_mod, racecheck_mod, contracts_mod,
+                plancheck_mod, liveness_mod, suppressions_mod):
+        rules.update(mod.RULES)
+    rules[loader_mod.SRC_RULE] = (loader_mod.SRC_SUMMARY,
+                                  loader_mod.SRC_HINT)
+    out = []
+    for rid in sorted(rules):
+        summary, hint = rules[rid]
+        entry = {"id": rid,
+                 "shortDescription": {"text": summary}}
+        if hint:
+            entry["help"] = {"text": hint}
+        out.append(entry)
+    return out
+
+
+def _sarif(per_tool: dict, warnings: list, root: str) -> dict:
+    """One SARIF 2.1.0 run over every tool's findings (level error)
+    plus the suppression-audit warnings (level warning), with full rule
+    metadata, for ``github/codeql-action/upload-sarif``."""
+    def result(f: Finding, level: str) -> dict:
+        path = os.path.relpath(f.path, root)
+        if path.startswith(".."):
+            path = f.path
+        msg = f.message + (f" | hint: {f.hint}" if f.hint else "")
+        return {
+            "ruleId": f.rule,
+            "level": level,
+            "message": {"text": msg},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": path.replace(os.sep, "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+
+    results = [result(f, "error")
+               for fs in per_tool.values() for f in fs]
+    results += [result(f, "warning") for f in warnings]
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "gelly-analysis",
+                "informationUri":
+                    "https://example.invalid/gelly_tpu/analysis",
+                "rules": _rule_metadata(),
+            }},
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file://" + root.rstrip("/") + "/"},
+            },
+            "results": results,
+        }],
+    }
+
+
+def _github_annotation(f: Finding, root: str,
+                       level: str = "error") -> str:
+    """One ``::error`` (or ``::warning``) workflow command per finding.
+    GitHub parses the message up to the first newline; data is
+    %-escaped per the workflow-command spec — property values
+    (``file=``/``title=``) additionally escape ``:`` and ``,``, the
+    property delimiters."""
     def esc(s: str) -> str:
         return (s.replace("%", "%25").replace("\r", "%0D")
                 .replace("\n", "%0A"))
@@ -122,7 +221,7 @@ def _github_annotation(f: Finding, root: str) -> str:
     if path.startswith(".."):
         path = f.path
     msg = f.message + (f" | hint: {f.hint}" if f.hint else "")
-    return (f"::error file={esc_prop(path)},line={f.line},"
+    return (f"::{level} file={esc_prop(path)},line={f.line},"
             f"title={esc_prop(f.rule)}::{esc(msg)}")
 
 
@@ -170,7 +269,7 @@ def main(argv=None) -> int:
         if tok == "--changed":
             nxt = argv[i + 1] if i + 1 < len(argv) else None
             if nxt is not None and not nxt.startswith("-") \
-                    and nxt not in TOOLS + ("all",) \
+                    and nxt not in SUBCOMMANDS \
                     and not os.path.exists(nxt):
                 norm.append(f"--changed={nxt}")
                 i += 2
@@ -196,7 +295,7 @@ def main(argv=None) -> int:
         if tok.startswith("-"):
             expecting_value = tok in value_flags  # "--flag value" form
             continue
-        if tok in TOOLS + ("all",):
+        if tok in SUBCOMMANDS:
             tool = tok
             argv.pop(i)
         break  # first positional decides either way
@@ -207,10 +306,12 @@ def main(argv=None) -> int:
                     "native/*.cc vs ctypes bindings, jit-hazard lint, "
                     "concurrency race/protocol-invariant check, "
                     "durability/wire/observability contract check and "
-                    "compiled-plan contract check of gelly_tpu/, "
-                    "optional native sanitizer smoke lane. "
+                    "compiled-plan contract check, liveness/progress "
+                    "check of gelly_tpu/, suppression audit, optional "
+                    "native sanitizer smoke lane. "
                     "Subcommands: abi | jitlint | racecheck | contracts "
-                    "| plancheck | all (default all).",
+                    "| plancheck | liveness | suppressions | all "
+                    "(default all).",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (jitlint + racecheck + "
@@ -241,17 +342,22 @@ def main(argv=None) -> int:
                     help="skip the durability-contract checker")
     ap.add_argument("--skip-plancheck", action="store_true",
                     help="skip the compiled-plan contract checker")
+    ap.add_argument("--skip-liveness", action="store_true",
+                    help="skip the liveness & progress checker")
     ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
                     metavar="REF",
                     help="lint only files that differ vs the given git "
                          "ref (default HEAD) plus untracked files; "
                          "whole-package rules still load the full set "
                          "but report only changed-file findings")
-    ap.add_argument("--format", choices=("text", "json", "github"),
+    ap.add_argument("--format",
+                    choices=("text", "json", "github", "sarif"),
                     default="text",
                     help="output format (json: one machine-readable "
                          "object on stdout, for CI; github: workflow "
-                         "::error annotations for inline PR display)")
+                         "::error annotations for inline PR display; "
+                         "sarif: one SARIF 2.1.0 document on stdout "
+                         "for github/codeql-action/upload-sarif)")
     ap.add_argument("--sanitize", choices=("asan", "ubsan", "both"),
                     default=None,
                     help="also run the native smoke workload under the "
@@ -274,6 +380,8 @@ def main(argv=None) -> int:
     run = {t: True for t in TOOLS}
     if tool in TOOLS:
         run = {t: t == tool for t in TOOLS}
+    elif tool == "suppressions":
+        run = {t: False for t in TOOLS}
     if args.skip_abi:
         run["abi"] = False
     if args.skip_jitlint:
@@ -284,6 +392,8 @@ def main(argv=None) -> int:
         run["contracts"] = False
     if args.skip_plancheck:
         run["plancheck"] = False
+    if args.skip_liveness:
+        run["liveness"] = False
 
     changed = None
     if args.changed is not None:
@@ -316,6 +426,9 @@ def main(argv=None) -> int:
     if run["plancheck"]:
         per_tool["plancheck"] = plancheck_mod.lint_paths(root, lint_paths,
                                                          cache=cache)
+    if run["liveness"]:
+        per_tool["liveness"] = liveness_mod.lint_paths(root, lint_paths,
+                                                       cache=cache)
 
     if changed is not None:
         # SRC001 is exempt from the changed-file scope: an unparseable
@@ -327,6 +440,19 @@ def main(argv=None) -> int:
                 or os.path.abspath(f.path) in changed]
             for t, fs in per_tool.items()
         }
+
+    # Suppression audit: THE GATE under the dedicated subcommand; a
+    # rides-along warning lane under --all (never flips rc there, so
+    # the finding gate and the hygiene gate read independently). The
+    # --changed fast path skips it — staleness needs full-package runs.
+    sup_gate = tool == "suppressions"
+    sup_findings: list[Finding] = []
+    if sup_gate or (tool in (None, "all") and changed is None):
+        sup_findings = suppressions_mod.audit(root, lint_paths,
+                                              cache=cache)
+    if sup_gate:
+        per_tool = {"suppressions": sup_findings}
+        sup_findings = []
 
     findings = [f for fs in per_tool.values() for f in fs]
     rc = 1 if findings else 0
@@ -352,12 +478,20 @@ def main(argv=None) -> int:
                 sanitize_lines.append(
                     proc.stdout.strip() or f"sanitize[{mode}]: clean")
 
+    if args.format == "sarif":
+        print(json.dumps(_sarif(per_tool, sup_findings, root), indent=1))
+        return rc
+
     if args.format == "github":
         for f in findings:
             print(_github_annotation(f, root))
+        for f in sup_findings:
+            print(_github_annotation(f, root, level="warning"))
         for t, fs in per_tool.items():
             print(f"{t}: {len(fs)} finding(s)",
                   file=sys.stderr if fs else sys.stdout)
+        if sup_findings:
+            print(f"suppressions: {len(sup_findings)} warning(s)")
         for line in sanitize_lines:
             print(line, file=sys.stderr if rc else sys.stdout)
         return rc
@@ -369,6 +503,10 @@ def main(argv=None) -> int:
                     "findings": [_finding_dict(f) for f in fs]}
                 for t, fs in per_tool.items()
             },
+            "suppressions": {
+                "count": len(sup_findings),
+                "findings": [_finding_dict(f) for f in sup_findings],
+            } if (sup_findings or tool in (None, "all")) else None,
             "sanitize": sanitize_lines or None,
             "total": len(findings),
             "ok": rc == 0,
@@ -377,17 +515,27 @@ def main(argv=None) -> int:
 
     for f in findings:
         print(f.render())
+    # Suppression-audit warnings (the --all ride-along): visible, never
+    # part of the exit code here — the dedicated subcommand is the gate.
+    for f in sup_findings:
+        print(f"warning: {f.render()}")
     # Per-tool summary — the exit-code contract made visible: non-zero
     # iff any count below is non-zero (or a sanitizer lane failed).
     for t, fs in per_tool.items():
         print(f"{t}: {len(fs)} finding(s)",
               file=sys.stderr if fs else sys.stdout)
+    if sup_findings:
+        print(f"suppressions: {len(sup_findings)} warning(s)")
     for line in sanitize_lines:
         print(line, file=sys.stderr if rc else sys.stdout)
     if rc == 0:
         checks = list(per_tool)
         if args.sanitize:
             checks.append(f"sanitize:{args.sanitize}")
+        if tool in (None, "all") and changed is None:
+            checks.append("suppressions-audit"
+                          if not sup_findings else
+                          f"suppressions:{len(sup_findings)} warning(s)")
         print(f"analysis clean ({', '.join(checks)})")
     return rc
 

@@ -139,12 +139,21 @@ _SYNC_METHODS = {"item", "tolist"}
 
 _SUPPRESS_RE = re.compile(r"#\s*graphlint:\s*disable=([A-Za-z0-9,\s]+)")
 
+#: When True, :func:`suppressed` reports every line as unsuppressed.
+#: The suppression AUDIT (analysis/suppressions.py) flips this while it
+#: re-runs the tools, so a directive whose rule no longer fires at its
+#: anchor can be detected as stale. Never set directly — use
+#: :func:`gelly_tpu.analysis.suppressions.ignoring_suppressions`.
+_IGNORE_SUPPRESSIONS = False
+
 
 def suppressed(lines: list, line: int, rule: str) -> bool:
     """THE ``# graphlint: disable=`` check, shared by every analysis
     tool (jitlint GLxxx, racecheck RCxxx/PIxxx): rule in the comma list,
     or ``all``, on the flagged line suppresses the finding. One parser —
     a syntax extension here applies to every rule family at once."""
+    if _IGNORE_SUPPRESSIONS:
+        return False
     if 1 <= line <= len(lines):
         sm = _SUPPRESS_RE.search(lines[line - 1])
         if sm:

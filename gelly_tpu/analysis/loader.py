@@ -69,16 +69,34 @@ class SourceCache:
     def __init__(self):
         self._mods: dict[str, ModuleSource] = {}
         self._errors: dict[str, SourceError] = {}
+        #: path -> (st_mtime_ns, st_size) at parse/record time. A hit
+        #: is served only while the stat signature still matches, so a
+        #: long-lived process (watch mode, an LSP, a test editing temp
+        #: files between loads) re-parses edited files instead of
+        #: serving stale trees.
+        self._stat: dict[str, tuple] = {}
+
+    @staticmethod
+    def _signature(path: str) -> tuple | None:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
 
     def get(self, path: str) -> ModuleSource | None:
         """The parsed module, or None with the failure recorded (read it
-        back via :meth:`error`)."""
+        back via :meth:`error`). Cached entries are invalidated when the
+        file's (mtime_ns, size) changes on disk."""
         path = os.path.abspath(path)
-        if path in self._mods:
-            return self._mods[path]
-        if path in self._errors:
-            return None
+        if path in self._mods or path in self._errors:
+            if self._signature(path) == self._stat.get(path):
+                return self._mods.get(path)
+            self._mods.pop(path, None)
+            self._errors.pop(path, None)
+            self._stat.pop(path, None)
         err = None
+        self._stat[path] = self._signature(path)
         try:
             with open(path, "rb") as f:
                 raw = f.read()

@@ -216,6 +216,15 @@ class IngestServer:
             self._q.put_nowait(_DONE)
         except Exception:  # queue full: consumer will still see _stop
             pass
+        # Retire the ingress ledger: frames stamped at receive but
+        # never consumed (staged at teardown, or a router-less run
+        # with no durable retirement) must not read as ever-growing
+        # backlog in max_backlog_age() after the stream is gone. Key
+        # read under the state lock — TenantRouter.attach rekeys the
+        # ledger under the same lock; drop() is a no-op when telemetry
+        # never stamped.
+        with self._state_lock:
+            obs_bus.get_bus().watermarks.drop(self.watermark_stream)
 
     close = stop
 

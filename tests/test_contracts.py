@@ -823,7 +823,7 @@ def test_cli_skip_contracts(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert set(payload["tools"]) == {"abi", "jitlint", "racecheck",
-                                     "plancheck"}
+                                     "plancheck", "liveness"}
 
 
 def test_cli_list_rules_includes_contract_rules(capsys):

@@ -1,0 +1,1105 @@
+"""gelly_tpu.analysis.liveness: liveness & progress checker.
+
+Every LV rule is exercised BOTH ways — a seeded-violation fixture that
+must flag (line-anchored) and a clean fixture proving the rule's
+exemption paths (stop-flag headers, timeout-poll idioms, unguarded
+tail flushes, teardown drops, bounded handoffs, the swap-to-local
+close idiom). The three historical bug classes are re-seeded verbatim
+and each flips the CLI exit code: the PR 8 batched-ack tail (LV203),
+the PR 10 stranded ``pipeline.staged_depth`` gauge (LV202), and the
+PR 14 coordinated-checkpoint ledger leak (LV302). The tip audit's one
+real finding — IngestServer ingress-stamping its wire watermark ledger
+with no exit in the class — has a static red/green pair here plus a
+behavioral regression (stamp, stop, assert no stranded backlog) in the
+server section. Satellites ride along: the suppression audit
+(SUP001/002/003, tokenized inventory, the ``suppressions`` gate vs the
+``--all`` warning lane), ``--format=sarif``, and the loader's
+mtime/size cache invalidation."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from gelly_tpu.analysis import jitlint, liveness, loader, suppressions
+from gelly_tpu.analysis.__main__ import main as analysis_main
+
+pytestmark = pytest.mark.liveness
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _lint_files(tmp_path, files):
+    paths = []
+    for name, src in files.items():
+        p = tmp_path / name
+        if isinstance(src, bytes):
+            p.write_bytes(src)
+        else:
+            p.write_text(src)
+        paths.append(str(p))
+    return liveness.lint_paths(str(tmp_path), paths)
+
+
+def _lint_src(tmp_path, src, name="fixture_mod.py"):
+    return _lint_files(tmp_path, {name: src})
+
+
+def _line_of(src, marker):
+    for i, line in enumerate(src.splitlines(), 1):
+        if marker in line:
+            return i
+    raise AssertionError(f"marker {marker!r} not in fixture")
+
+
+def _rules(findings):
+    return [(f.rule, f.line) for f in findings]
+
+
+# --------------------------------------------------------------------- #
+# repo tip (ISSUE 16 acceptance: zero unsuppressed findings, and the
+# root discovery the tip-clean assertion rests on is not vacuous)
+
+def test_liveness_clean_on_repo_tip():
+    findings = liveness.lint_paths(REPO, [os.path.join(REPO, "gelly_tpu")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_tip_root_closure_not_vacuous():
+    # Tip-clean proves nothing if no thread root was discovered or the
+    # reachability closure stayed empty: the checker must be walking
+    # the real serving-plane loops (ingest accept/conn, tenant drive,
+    # router drain, checkpoint writer).
+    c = liveness.LivenessChecker(REPO)
+    c.lint_paths([os.path.join(REPO, "gelly_tpu")])
+    assert len(c._rc.roots) >= 10
+    assert len(c._reach) >= len(c._rc.roots)
+    reached_files = {os.path.basename(m.path)
+                     for m, _c, _f, _s, _r in c._reach.values()}
+    assert {"server.py", "tenants.py", "resilience.py"} <= reached_files
+
+
+# --------------------------------------------------------------------- #
+# LV101: root-reachable while-True with no exit path
+
+LV101_FLAG = textwrap.dedent('''\
+    import threading
+
+    class Poller:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def stop(self):
+            self._t.join()
+
+        def _run(self):
+            while True:  # anchor-101
+                self._tick()
+
+        def _tick(self):
+            pass
+''')
+
+
+def test_lv101_flags_unterminated_root_loop(tmp_path):
+    findings = _lint_src(tmp_path, LV101_FLAG)
+    assert _rules(findings) == [("LV101", _line_of(LV101_FLAG,
+                                                   "anchor-101"))]
+
+
+def test_lv101_clean_on_stop_flag_header_and_break(tmp_path):
+    src = textwrap.dedent('''\
+        import threading
+
+        class Poller:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+                threading.Thread(target=self._drain, daemon=True).start()
+
+            def stop(self):
+                self._stop.set()
+                self._t.join()
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self._tick()
+
+            def _drain(self):
+                while True:
+                    if self._stop.is_set():
+                        break
+                    self._tick()
+
+            def _tick(self):
+                pass
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv101_ignores_unreachable_and_generator_loops(tmp_path):
+    # A while-True in a function no thread root reaches is main-thread
+    # code (its caller bounds it); a generator's while-True is driven
+    # and closeable by its consumer.
+    src = textwrap.dedent('''\
+        def batches(q):
+            while True:
+                yield q.popleft()
+
+        def spin_forever():
+            while True:
+                pass
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv101_break_in_nested_loop_is_not_a_witness(tmp_path):
+    # The break belongs to the inner for — the outer while-True still
+    # has no exit.
+    src = textwrap.dedent('''\
+        import threading
+
+        class Worker:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def stop(self):
+                self._stop = True
+
+            def _run(self):
+                while True:  # anchor-101
+                    for item in self._items:
+                        if item is None:
+                            break
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == [("LV101", _line_of(src, "anchor-101"))]
+
+
+# --------------------------------------------------------------------- #
+# LV102: untimed blocking call in a root-reachable loop
+
+LV102_FLAG = textwrap.dedent('''\
+    import threading
+
+    class Consumer:
+        def start(self):
+            threading.Thread(target=self._drain, daemon=True).start()
+
+        def stop(self):
+            self._stop.set()
+
+        def _drain(self):
+            while not self._stop.is_set():
+                item = self._q.get()  # anchor-102
+                self._handle(item)
+
+        def _handle(self, item):
+            pass
+''')
+
+
+def test_lv102_flags_untimed_get(tmp_path):
+    findings = _lint_src(tmp_path, LV102_FLAG)
+    assert _rules(findings) == [("LV102", _line_of(LV102_FLAG,
+                                                   "anchor-102"))]
+
+
+def test_lv102_clean_on_timeout_poll_idioms(tmp_path):
+    # The three vetted idioms: a timeout= kwarg, an except-timeout
+    # guard around a bare recv, and a component-scope settimeout
+    # covering accept.
+    src = textwrap.dedent('''\
+        import queue
+        import socket
+        import threading
+
+        class Consumer:
+            def start(self):
+                threading.Thread(target=self._drain, daemon=True).start()
+                threading.Thread(target=self._recv_loop,
+                                 daemon=True).start()
+                threading.Thread(target=self._accept_loop,
+                                 daemon=True).start()
+
+            def stop(self):
+                self._stop.set()
+
+            def _drain(self):
+                while not self._stop.is_set():
+                    try:
+                        item = self._q.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                    self._handle(item)
+
+            def _recv_loop(self):
+                while not self._stop.is_set():
+                    try:
+                        data = self._sock.recv(4096)
+                    except socket.timeout:
+                        continue
+                    self._handle(data)
+
+            def _accept_loop(self):
+                self._listener.settimeout(0.1)
+                while not self._stop.is_set():
+                    try:
+                        conn, _ = self._listener.accept()
+                    except socket.timeout:
+                        continue
+                    self._handle(conn)
+
+            def _handle(self, item):
+                pass
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+# --------------------------------------------------------------------- #
+# LV201: PAUSE emitted without a reachable RESUME
+
+LV201_FLAG = textwrap.dedent('''\
+    PAUSE = 6
+    RESUME = 7
+
+    class Throttle:
+        def apply(self, sock):
+            sock.sendall(pack(PAUSE, 0))  # anchor-201
+''')
+
+
+def test_lv201_flags_pause_without_resume(tmp_path):
+    findings = _lint_src(tmp_path, LV201_FLAG)
+    assert _rules(findings) == [("LV201", _line_of(LV201_FLAG,
+                                                   "anchor-201"))]
+
+
+def test_lv201_clean_when_component_resumes(tmp_path):
+    src = textwrap.dedent('''\
+        PAUSE = 6
+        RESUME = 7
+
+        class Throttle:
+            def apply(self, sock):
+                sock.sendall(pack(PAUSE, 0))
+                try:
+                    self._wait_drained()
+                finally:
+                    sock.sendall(pack(RESUME, 0))
+
+            def _wait_drained(self):
+                pass
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+# --------------------------------------------------------------------- #
+# LV202: polled gauge with no background publisher (the PR 10 class)
+
+LV202_FLAG = textwrap.dedent('''\
+    import time
+
+    class Backpressure:
+        def submit(self, item):
+            self._stage(item)
+            self.bus.gauge("pipeline.staged_depth", self.depth)
+
+        def wait_drained(self):
+            while self.bus.gauges.get("pipeline.staged_depth", 0) > self.low:  # anchor-202
+                time.sleep(0.01)
+
+        def _stage(self, item):
+            pass
+''')
+
+
+def test_lv202_flags_submit_path_only_gauge(tmp_path):
+    # The PR 10 bug verbatim: the RESUME condition polls a gauge only
+    # the submit path re-publishes — once submission stops the poll
+    # spins forever.
+    findings = _lint_src(tmp_path, LV202_FLAG)
+    assert _rules(findings) == [("LV202", _line_of(LV202_FLAG,
+                                                   "anchor-202"))]
+    assert "submit path" in findings[0].message
+
+
+def test_lv202_flags_never_published_gauge(tmp_path):
+    src = textwrap.dedent('''\
+        class Waiter:
+            def wait(self):
+                while self.bus.gauges.get("ghost.depth", 0) > 0:  # anchor-202
+                    pass
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == [("LV202", _line_of(src, "anchor-202"))]
+    assert "never published" in findings[0].message
+
+
+def test_lv202_clean_with_root_reachable_publisher(tmp_path):
+    src = LV202_FLAG + textwrap.dedent('''\
+
+        class Drainer:
+            def start(self):
+                import threading
+                threading.Thread(target=self._drain, daemon=True).start()
+
+            def stop(self):
+                self._stop.set()
+
+            def _drain(self):
+                while not self._stop.is_set():
+                    self.bus.gauge("pipeline.staged_depth",
+                                   self.q.qsize())
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv202_clean_with_enqueue_hook_lambda_publisher(tmp_path):
+    # The aggregation idiom: the gauge hook is a lambda handed to the
+    # prefetch plumbing — published from the worker side, not a loop
+    # the closure scan can see, so closures count as background.
+    src = textwrap.dedent('''\
+        class Pipe:
+            def build(self):
+                return make_stage(
+                    gauge=lambda d: self.bus.gauge("pipe.depth", d))
+
+            def wait(self):
+                while self.bus.gauges.get("pipe.depth", 0) > self.low:
+                    pass
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+# --------------------------------------------------------------------- #
+# LV203: accumulator flushed only under its threshold (the PR 8 class)
+
+LV203_FLAG = textwrap.dedent('''\
+    import threading
+
+    class AckServer:
+        def start(self):
+            threading.Thread(target=self._conn_loop, daemon=True).start()
+
+        def stop(self):
+            self._stop.set()
+
+        def _conn_loop(self, sock):
+            pending = []  # anchor-203
+            while not self._stop.is_set():
+                seq = self._read(sock)
+                pending.append(seq)
+                if len(pending) >= self.ack_every:
+                    self._send_ack(sock, pending)
+                    pending = []
+
+        def _read(self, sock):
+            return 0
+
+        def _send_ack(self, sock, seqs):
+            pass
+''')
+
+
+def test_lv203_flags_threshold_only_flush(tmp_path):
+    # The PR 8 bug verbatim: acks batch up and flush only at
+    # ack_every — a stream going idle below the threshold strands the
+    # tail and the client's flush() hangs forever.
+    findings = _lint_src(tmp_path, LV203_FLAG)
+    assert _rules(findings) == [("LV203", _line_of(LV203_FLAG,
+                                                   "anchor-203"))]
+
+
+def test_lv203_clean_with_tail_flush_after_loop(tmp_path):
+    src = LV203_FLAG.replace(
+        "    def _read(self, sock):",
+        "        if pending:\n"
+        "            self._send_ack(sock, pending)\n"
+        "\n"
+        "    def _read(self, sock):", 1)
+    assert "if pending:" in src
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv203_clean_with_idle_hook_flush(tmp_path):
+    # The tip's actual fix shape: an unguarded flush in a nested idle
+    # hook (`if pending:` is a presence test, not a threshold guard).
+    src = textwrap.dedent('''\
+        import threading
+
+        class AckServer:
+            def start(self):
+                threading.Thread(target=self._conn_loop,
+                                 daemon=True).start()
+
+            def stop(self):
+                self._stop.set()
+
+            def _conn_loop(self, sock):
+                pending = [0]
+
+                def flush_tail():
+                    if pending[0]:
+                        self._send_ack(sock, pending[0])
+                        pending[0] = 0
+
+                recv = make_recv(sock, idle=flush_tail)
+                while not self._stop.is_set():
+                    self._read(recv)
+                    pending[0] += 1
+                    if pending[0] >= self.ack_every:
+                        pending[0] = 0
+                        self._send_ack(sock, pending[0])
+
+            def _read(self, recv):
+                return 0
+
+            def _send_ack(self, sock, upto):
+                pass
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+# --------------------------------------------------------------------- #
+# LV301: ledger enter with no exit in the component (the tip finding)
+
+LV301_FLAG = textwrap.dedent('''\
+    class Ingest:
+        def on_frame(self, seq):
+            self.bus.watermarks.stamp("wire", seq)  # anchor-301
+''')
+
+
+def test_lv301_flags_stamp_without_exit(tmp_path):
+    findings = _lint_src(tmp_path, LV301_FLAG)
+    assert _rules(findings) == [("LV301", _line_of(LV301_FLAG,
+                                                   "anchor-301"))]
+
+
+def test_lv301_clean_with_teardown_drop(tmp_path):
+    # The shape of the tip fix: stop() drops the stream the ingress
+    # path stamped.
+    src = LV301_FLAG + textwrap.dedent('''\
+
+        def stop(self):
+            self.bus.watermarks.drop("wire")
+    ''').replace("\n", "\n    ").rstrip() + "\n"
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv301_tracks_ledger_alias_and_call_base_chains(tmp_path):
+    # `wm = <...>.watermarks` aliases and call-in-chain bases
+    # (get_bus().watermarks.stamp) must both resolve — the tip uses
+    # both spellings.
+    src = textwrap.dedent('''\
+        class Runner:
+            def setup(self):
+                self.wm = None
+
+            def run(self, bus):
+                wm = bus.watermarks
+                wm.stamp("stream", self.position)  # anchor-301
+
+        class Submitter:
+            def submit(self, seq):
+                get_bus().watermarks.stamp(str(seq), seq)  # anchor-301b
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == [
+        ("LV301", _line_of(src, "anchor-301")),
+        ("LV301", _line_of(src, "anchor-301b")),
+    ]
+
+
+def test_lv301_retire_fold_is_not_an_exit(tmp_path):
+    # retire_fold observes latency but keeps the stamps — a component
+    # that only fold-retires still leaks durably.
+    src = textwrap.dedent('''\
+        class Folder:
+            def on_chunk(self, seq):
+                self.bus.watermarks.stamp("stream", seq)  # anchor-301
+
+            def on_fold(self, upto):
+                self.bus.watermarks.retire_fold("stream", upto)
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == [("LV301", _line_of(src, "anchor-301"))]
+
+
+# --------------------------------------------------------------------- #
+# LV302: exit on only one sibling durability branch (the PR 14 class)
+
+LV302_FLAG = textwrap.dedent('''\
+    class Runner:
+        def _maybe_checkpoint(self):
+            if self.coordinator is None:
+                self._checkpoint_local()
+            else:
+                self._checkpoint_coordinated()  # anchor-302
+
+        def _checkpoint_local(self):
+            save_checkpoint(self.path)
+            self._retire()
+
+        def _checkpoint_coordinated(self):
+            self.coordinator.checkpoint_all(self.path)
+
+        def _retire(self):
+            self.bus.watermarks.retire_durable("stream", self.position)
+''')
+
+
+def test_lv302_flags_coordinated_branch_leak(tmp_path):
+    # The PR 14 bug verbatim: both dispatch branches publish a
+    # checkpoint, only the local one retires the ledger — one stamp
+    # leaks per chunk on the coordinated path.
+    findings = _lint_src(tmp_path, LV302_FLAG)
+    assert _rules(findings) == [("LV302", _line_of(LV302_FLAG,
+                                                   "anchor-302"))]
+
+
+def test_lv302_clean_when_both_branches_retire(tmp_path):
+    src = LV302_FLAG.replace(
+        "        self.coordinator.checkpoint_all(self.path)",
+        "        self.coordinator.checkpoint_all(self.path)\n"
+        "        self._retire()")
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv302_silent_on_components_without_ledger_calls(tmp_path):
+    # An if/else over checkpoint helpers in a component that never
+    # touches a ledger is out of scope — no enter/exit to pair.
+    src = textwrap.dedent('''\
+        class Saver:
+            def save(self):
+                if self.fast:
+                    quick_checkpoint(self.path)
+                else:
+                    full_checkpoint(self.path)
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+# --------------------------------------------------------------------- #
+# LV303: pending-map insert with no removal
+
+LV303_FLAG = textwrap.dedent('''\
+    class Client:
+        def __init__(self):
+            self._unacked = {}
+
+        def send(self, seq, frame):
+            self._unacked[seq] = frame  # anchor-303
+''')
+
+
+def test_lv303_flags_insert_without_removal(tmp_path):
+    findings = _lint_src(tmp_path, LV303_FLAG)
+    assert _rules(findings) == [("LV303", _line_of(LV303_FLAG,
+                                                   "anchor-303"))]
+
+
+def test_lv303_clean_with_pop_del_or_clear(tmp_path):
+    src = LV303_FLAG + (
+        "\n"
+        "    def on_ack(self, upto):\n"
+        "        for seq in [s for s in self._unacked if s < upto]:\n"
+        "            del self._unacked[seq]\n")
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv303_counter_increment_without_decrement(tmp_path):
+    src = textwrap.dedent('''\
+        class Tracker:
+            def enter(self):
+                self._in_flight += 1  # anchor-303
+
+        class Balanced:
+            def enter(self):
+                self._in_flight += 1
+
+            def leave(self):
+                self._in_flight -= 1
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == [("LV303", _line_of(src, "anchor-303"))]
+
+
+def test_lv303_ignores_non_pending_attrs(tmp_path):
+    src = textwrap.dedent('''\
+        class Cache:
+            def put(self, k, v):
+                self._memo[k] = v
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+# --------------------------------------------------------------------- #
+# LV401: thread started with no reachable join/stop signal
+
+LV401_FLAG = textwrap.dedent('''\
+    import threading
+
+    class Heart:
+        def start(self):
+            t = threading.Thread(target=self._beat, daemon=True)  # anchor-401
+            t.start()
+
+        def _beat(self):
+            while not self._running:
+                self._pulse()
+
+        def _pulse(self):
+            pass
+''')
+
+
+def test_lv401_flags_unstoppable_thread(tmp_path):
+    findings = _lint_src(tmp_path, LV401_FLAG)
+    assert _rules(findings) == [("LV401", _line_of(LV401_FLAG,
+                                                   "anchor-401"))]
+
+
+def test_lv401_clean_with_stop_flag_write(tmp_path):
+    src = LV401_FLAG + (
+        "\n"
+        "    def stop(self):\n"
+        "        self._running = False\n")
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv401_clean_on_event_set_and_join(tmp_path):
+    src = textwrap.dedent('''\
+        import threading
+
+        class Board:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def close(self):
+                self._stop.set()
+                self._t.join(timeout=1.0)
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self._tick()
+
+            def _tick(self):
+                pass
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv401_bounded_handoff_is_exempt(tmp_path):
+    # The Watchdog idiom: the spawning function awaits the worker with
+    # a timeout and deliberately abandons it on expiry — the spawn is
+    # bounded by its caller, not a daemon needing a stop path.
+    src = textwrap.dedent('''\
+        import threading
+
+        class Watchdog:
+            def call(self, fn):
+                done = threading.Event()
+                t = threading.Thread(target=lambda: self._run(fn, done),
+                                     daemon=True)
+                t.start()
+                if not done.wait(self.timeout):
+                    raise TimeoutError("stalled")
+
+            def _run(self, fn, done):
+                fn()
+                done.set()
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+# --------------------------------------------------------------------- #
+# LV402: socket/file on self with no close path
+
+LV402_FLAG = textwrap.dedent('''\
+    import socket
+
+    class Listener:
+        def start(self):
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # anchor-402
+            self._sock.bind((self.host, 0))
+''')
+
+
+def test_lv402_flags_unclosed_socket_attr(tmp_path):
+    findings = _lint_src(tmp_path, LV402_FLAG)
+    assert _rules(findings) == [("LV402", _line_of(LV402_FLAG,
+                                                   "anchor-402"))]
+
+
+def test_lv402_clean_on_direct_close_and_helper_pass(tmp_path):
+    src = textwrap.dedent('''\
+        import socket
+
+        def _close_quietly(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+        class Listener:
+            def start(self):
+                self._sock = socket.socket(socket.AF_INET,
+                                           socket.SOCK_STREAM)
+                self._conn = socket.create_connection(self.addr)
+
+            def stop(self):
+                self._sock.close()
+                _close_quietly(self._conn)
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv402_swap_to_local_close_idiom_is_clean(tmp_path):
+    # The IngestClient teardown shape: the attribute is swapped into a
+    # local under the lock, then the local is closed.
+    src = textwrap.dedent('''\
+        import socket
+
+        class Client:
+            def connect(self):
+                self._sock = socket.create_connection(self.addr)
+
+            def close(self):
+                with self._lock:
+                    sock, self._sock = self._sock, None
+                if sock is not None:
+                    sock.close()
+    ''')
+    assert _lint_src(tmp_path, src) == []
+
+
+def test_lv402_open_via_local_then_self_assign(tmp_path):
+    # The IngestServer start() shape: opened into a local, configured,
+    # then published onto self — still an open site.
+    src = textwrap.dedent('''\
+        import socket
+
+        class Server:
+            def start(self):
+                ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                ls.bind((self.host, 0))
+                self._listener = ls  # anchor-402
+    ''')
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == [("LV402", _line_of(src, "anchor-402"))]
+
+
+# --------------------------------------------------------------------- #
+# suppression scoping
+
+def test_lv_suppression_is_line_and_rule_scoped(tmp_path):
+    suppressed_src = LV301_FLAG.replace(
+        "  # anchor-301",
+        "  # graphlint: disable=LV301 -- exit lives in the router")
+    assert _lint_src(tmp_path, suppressed_src) == []
+    wrong_rule = LV301_FLAG.replace(
+        "  # anchor-301",
+        "  # graphlint: disable=LV101 -- wrong rule, must not mask")
+    assert [f.rule for f in _lint_src(tmp_path, wrong_rule)] == ["LV301"]
+
+
+# --------------------------------------------------------------------- #
+# the three historical bug classes flip the CLI exit code
+
+@pytest.mark.parametrize("src,rule", [
+    (LV203_FLAG, "LV203"),   # PR 8: batched-ack tail never flushed
+    (LV202_FLAG, "LV202"),   # PR 10: stranded pause-gauge
+    (LV302_FLAG, "LV302"),   # PR 14: coordinated-path ledger leak
+], ids=["pr8-ack-tail", "pr10-stranded-gauge", "pr14-ledger-leak"])
+def test_historical_bug_classes_flip_cli_exit_code(tmp_path, src, rule,
+                                                   capsys):
+    (tmp_path / "seeded.py").write_text(src)
+    rc = analysis_main(["liveness", str(tmp_path), "--root", REPO])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert rule in captured.out
+    assert "liveness: 1 finding(s)" in captured.err
+
+
+def test_tip_fix_regression_server_drops_wire_ledger_on_stop():
+    # Red/green for the tip audit's real finding: IngestServer ingress-
+    # stamps its wire watermark ledger; before the fix nothing in the
+    # class ever retired it, so staged-but-unconsumed frames read as
+    # permanently growing backlog after stop(). Green: stop() drops
+    # the stream.
+    from gelly_tpu import obs
+    from gelly_tpu.ingest.server import IngestServer
+
+    with obs.scope() as bus, obs.record_metrics():
+        srv = IngestServer(port=0)
+        try:
+            bus.watermarks.stamp(srv.watermark_stream, 0)
+            assert bus.watermarks.snapshot()[
+                srv.watermark_stream]["pending"] == 1
+        finally:
+            srv.stop()
+        assert srv.watermark_stream not in bus.watermarks.snapshot()
+        assert bus.watermarks.max_backlog_age() == 0.0
+
+
+# --------------------------------------------------------------------- #
+# CLI surface
+
+def test_cli_liveness_subcommand_exit_zero_on_tip(capsys):
+    rc = analysis_main(["liveness", os.path.join(REPO, "gelly_tpu"),
+                        "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "liveness: 0 finding(s)" in out
+    assert "analysis clean (liveness)" in out
+
+
+def test_cli_skip_liveness(capsys):
+    rc = analysis_main(["--all", "--root", REPO, "--skip-liveness",
+                        "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert "liveness" not in payload["tools"]
+    assert set(payload["tools"]) == {"abi", "jitlint", "racecheck",
+                                     "contracts", "plancheck"}
+
+
+def test_cli_json_format_carries_liveness_findings(tmp_path, capsys):
+    (tmp_path / "seeded.py").write_text(LV301_FLAG)
+    rc = analysis_main(["liveness", str(tmp_path), "--root", REPO,
+                        "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and payload["ok"] is False
+    assert payload["tools"]["liveness"]["count"] == 1
+    f0 = payload["tools"]["liveness"]["findings"][0]
+    assert f0["rule"] == "LV301"
+    assert f0["line"] == _line_of(LV301_FLAG, "anchor-301")
+
+
+def test_cli_github_format_annotates_liveness(tmp_path, capsys):
+    (tmp_path / "seeded.py").write_text(LV101_FLAG)
+    rc = analysis_main(["liveness", str(tmp_path), "--root", REPO,
+                        "--format=github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "::error file=" in out and "title=LV101" in out
+
+
+def test_cli_list_rules_includes_lv_and_sup(capsys):
+    assert analysis_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("LV101", "LV102", "LV201", "LV202", "LV203", "LV301",
+                "LV302", "LV303", "LV401", "LV402", "SUP001", "SUP002",
+                "SUP003"):
+        assert rid in out
+
+
+def test_unparseable_file_is_loud_from_liveness(tmp_path):
+    findings = _lint_src(tmp_path, "def broken(:\n", name="bad.py")
+    assert [f.rule for f in findings] == ["SRC001"]
+
+
+# --------------------------------------------------------------------- #
+# satellite: --format=sarif
+
+def test_sarif_document_shape_and_rule_metadata(tmp_path, capsys):
+    (tmp_path / "seeded.py").write_text(LV301_FLAG)
+    rc = analysis_main(["liveness", str(tmp_path), "--root", REPO,
+                        "--format=sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "gelly-analysis"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    # one run carries the metadata of EVERY tool's rules
+    for rid in ("AB001", "GL001", "RC001", "PI001", "EO001", "WP001",
+                "OB001", "PC101", "LV101", "SUP001", "SRC001"):
+        assert rid in rule_ids
+    (res,) = run["results"]
+    assert res["ruleId"] == "LV301" and res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == _line_of(LV301_FLAG,
+                                                 "anchor-301")
+    assert loc["artifactLocation"]["uri"].endswith("seeded.py")
+
+
+def test_sarif_clean_tip_has_no_results(capsys):
+    rc = analysis_main(["liveness", os.path.join(REPO, "gelly_tpu"),
+                        "--root", REPO, "--format=sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["runs"][0]["results"] == []
+
+
+# --------------------------------------------------------------------- #
+# satellite: suppression audit
+
+def _audit_files(tmp_path, files):
+    for name, src in files.items():
+        (tmp_path / name).write_text(src)
+    return suppressions.audit(str(tmp_path),
+                              [str(tmp_path / n) for n in files])
+
+
+def test_sup001_justification_required(tmp_path):
+    bare = LV301_FLAG.replace("  # anchor-301",
+                              "  # graphlint: disable=LV301")
+    findings = _audit_files(tmp_path, {"mod.py": bare})
+    assert [f.rule for f in findings] == ["SUP001"]
+
+
+def test_sup001_accepts_trailing_and_preceding_justifications(tmp_path):
+    trailing = LV301_FLAG.replace(
+        "  # anchor-301",
+        "  # graphlint: disable=LV301 -- the router owns the exit")
+    preceding = LV301_FLAG.replace(
+        "        self.bus.watermarks.stamp(\"wire\", seq)  # anchor-301",
+        "        # Vetted: the exit lives in the attached router's\n"
+        "        # drain loop, outside this component.\n"
+        "        self.bus.watermarks.stamp(\"wire\", seq)"
+        "  # graphlint: disable=LV301")
+    assert _audit_files(tmp_path, {"a.py": trailing}) == []
+    assert _audit_files(tmp_path, {"b.py": preceding}) == []
+
+
+def test_sup002_stale_suppression_flagged(tmp_path):
+    # The directive names a rule that does NOT fire on this line any
+    # more — it must be reported stale, not silently kept.
+    src = textwrap.dedent('''\
+        class Quiet:
+            def fine(self):
+                return 1  # graphlint: disable=LV301 -- was needed once
+    ''')
+    findings = _audit_files(tmp_path, {"mod.py": src})
+    assert [f.rule for f in findings] == ["SUP002"]
+
+
+def test_sup002_live_suppression_not_stale(tmp_path):
+    live = LV301_FLAG.replace(
+        "  # anchor-301",
+        "  # graphlint: disable=LV301 -- the router owns the exit")
+    assert _audit_files(tmp_path, {"mod.py": live}) == []
+
+
+def test_sup003_unknown_rule_id(tmp_path):
+    src = textwrap.dedent('''\
+        x = 1  # graphlint: disable=LV999 -- typo that masks nothing
+    ''')
+    findings = _audit_files(tmp_path, {"mod.py": src})
+    assert [f.rule for f in findings] == ["SUP003"]
+
+
+def test_inventory_ignores_docstring_mentions(tmp_path):
+    # Every analysis module's docstring QUOTES the directive syntax —
+    # the inventory tokenizes, so string literals are not directives.
+    src = textwrap.dedent('''\
+        """Suppress with ``# graphlint: disable=LVxxx`` on the line."""
+        HELP = "use # graphlint: disable=RC001 to vet an exception"
+    ''')
+    (tmp_path / "doc.py").write_text(src)
+    assert suppressions.inventory([str(tmp_path / "doc.py")]) == []
+    assert _audit_files(tmp_path, {"doc2.py": src}) == []
+
+
+def test_ignoring_suppressions_restores_flag_on_error():
+    assert jitlint._IGNORE_SUPPRESSIONS is False
+    with pytest.raises(RuntimeError):
+        with suppressions.ignoring_suppressions():
+            assert jitlint._IGNORE_SUPPRESSIONS is True
+            raise RuntimeError("boom")
+    assert jitlint._IGNORE_SUPPRESSIONS is False
+
+
+def test_cli_suppressions_gate_exit_code(tmp_path, capsys):
+    bare = LV301_FLAG.replace("  # anchor-301",
+                              "  # graphlint: disable=LV301")
+    (tmp_path / "mod.py").write_text(bare)
+    rc = analysis_main(["suppressions", str(tmp_path), "--root", REPO])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "SUP001" in captured.out
+    assert "suppressions: 1 finding(s)" in captured.err
+
+
+def test_cli_suppressions_gate_clean_on_tip(capsys):
+    rc = analysis_main(["suppressions",
+                        os.path.join(REPO, "gelly_tpu"), "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "analysis clean (suppressions)" in out
+
+
+def test_tip_audit_is_not_vacuous():
+    # The tip-clean gate above must actually be exercising directives:
+    # the package carries vetted suppressions, and their rules still
+    # fire when directives are ignored (else SUP002 would flag).
+    inv = suppressions.inventory([os.path.join(REPO, "gelly_tpu")])
+    assert len(inv) >= 2
+    rules = {r for _p, _l, rs, _m, _ls in inv for r in rs}
+    assert {"RC006", "EO004"} <= rules
+
+
+def test_cli_all_reports_suppression_warnings_without_rc_flip(tmp_path,
+                                                              capsys):
+    # Under --all the audit is a warning lane: visible, never the exit
+    # code (the dedicated subcommand is the gate).
+    bare = textwrap.dedent('''\
+        x = 1  # graphlint: disable=LV999
+    ''')
+    (tmp_path / "mod.py").write_text(bare)
+    rc = analysis_main(["--all", str(tmp_path), "--root", REPO,
+                        "--skip-abi", "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["ok"] is True
+    sup = payload["suppressions"]
+    assert sup["count"] >= 1
+    assert any(f["rule"] == "SUP003" for f in sup["findings"])
+
+
+# --------------------------------------------------------------------- #
+# satellite: loader mtime/size cache invalidation
+
+def test_loader_reparses_edited_file(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("x = 1\n")
+    cache = loader.SourceCache()
+    first = cache.get(str(p))
+    assert first is not None and "x = 1" in first.src
+    # Same content length, different content — mtime must invalidate.
+    p.write_text("y = 2\n")
+    os.utime(p, ns=(os.stat(p).st_atime_ns,
+                    os.stat(p).st_mtime_ns + 1_000_000))
+    second = cache.get(str(p))
+    assert second is not first
+    assert "y = 2" in second.src
+
+
+def test_loader_serves_cached_tree_while_unchanged(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("x = 1\n")
+    cache = loader.SourceCache()
+    a = cache.get(str(p))
+    b = cache.get(str(p))
+    assert a is b and a.tree is b.tree
+
+
+def test_loader_error_entry_invalidated_on_fix(tmp_path):
+    # A file cached as unparseable must be re-read once it is fixed on
+    # disk — a watch-mode process must not report a stale SRC001.
+    p = tmp_path / "mod.py"
+    p.write_text("def broken(:\n")
+    cache = loader.SourceCache()
+    assert cache.get(str(p)) is None
+    assert cache.error(str(p)) is not None
+    p.write_text("def fixed():\n    return 1\n")
+    os.utime(p, ns=(os.stat(p).st_atime_ns,
+                    os.stat(p).st_mtime_ns + 1_000_000))
+    ms = cache.get(str(p))
+    assert ms is not None and "fixed" in ms.src
+    assert cache.error(str(p)) is None

@@ -753,7 +753,7 @@ def test_cli_skip_plancheck(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert set(payload["tools"]) == {"abi", "jitlint", "racecheck",
-                                     "contracts"}
+                                     "contracts", "liveness"}
 
 
 # --------------------------------------------------------------------- #
@@ -776,7 +776,8 @@ def test_unparseable_files_are_loud_from_every_tool(tmp_path, capsys):
                         "--format=json"])
     payload = json.loads(capsys.readouterr().out)
     assert rc == 1
-    for tool in ("jitlint", "racecheck", "contracts", "plancheck"):
+    for tool in ("jitlint", "racecheck", "contracts", "plancheck",
+                 "liveness"):
         fs = payload["tools"][tool]["findings"]
         assert all(f["rule"] == "SRC001" for f in fs), (tool, fs)
         names = {os.path.basename(f["path"]) for f in fs}
@@ -790,7 +791,7 @@ def test_unparseable_files_are_loud_from_every_tool(tmp_path, capsys):
 
 
 @pytest.mark.parametrize("tool", ["jitlint", "racecheck", "contracts",
-                                  "plancheck"])
+                                  "plancheck", "liveness"])
 def test_single_tool_cli_exit_nonzero_on_broken_file(tmp_path, tool,
                                                      capsys):
     (tmp_path / "bad_syntax.py").write_text("def broken(:\n")
@@ -836,7 +837,8 @@ def test_src001_is_deduplicated_per_tool(tmp_path, capsys):
                         "--format=json"])
     payload = json.loads(capsys.readouterr().out)
     assert rc == 1
-    for tool in ("jitlint", "racecheck", "contracts", "plancheck"):
+    for tool in ("jitlint", "racecheck", "contracts", "plancheck",
+                 "liveness"):
         assert payload["tools"][tool]["count"] == 1, tool
 
 

@@ -413,10 +413,10 @@ def test_cli_all_prints_per_tool_summary(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     for tool in ("abi", "jitlint", "racecheck", "contracts",
-                 "plancheck"):
+                 "plancheck", "liveness"):
         assert f"{tool}: 0 finding(s)" in out
     assert ("analysis clean (abi, jitlint, racecheck, contracts, "
-            "plancheck)") in out
+            "plancheck, liveness, suppressions-audit)") in out
 
 
 def test_cli_nonzero_and_counts_on_findings(tmp_path, capsys):
@@ -443,7 +443,8 @@ def test_cli_json_format(tmp_path, capsys):
     payload2 = json.loads(capsys.readouterr().out)
     assert rc2 == 0 and payload2["ok"] is True
     assert set(payload2["tools"]) == {"abi", "jitlint", "racecheck",
-                                      "contracts", "plancheck"}
+                                      "contracts", "plancheck",
+                                      "liveness"}
 
 
 def test_cli_list_rules_includes_rc_and_pi(capsys):
