@@ -2831,6 +2831,134 @@ def bench_tenants(args) -> dict:
             "parity": parity,
         }
 
+    # QoS policy plane (ISSUE 17). Two captures, neither a scaling
+    # claim: (a) weighted fair share at the DRR grant level — the
+    # deterministic ⌊R·wᵢ/w_max⌋−1 fairness bound, measured over R
+    # rounds of an always-backlogged 1:2:4 mix; (b) the degradation
+    # ladder end-to-end through the engine (limit → park → un-park →
+    # re-park → shed) with the backlog-age watermark driven directly —
+    # the bench has no wire, so the signal input is the same seam the
+    # QoS suite uses — recording the transition counts and the
+    # bounded-backlog bit (the shed queue really dropped and the
+    # surviving tenant completed).
+    from gelly_tpu.engine.qos import QosController, QosPolicy
+    from gelly_tpu.obs import bus as obs_bus
+
+    weights = {"bronze": 1.0, "silver": 2.0, "gold": 4.0}
+    qc = QosController(per_tenant={
+        t: QosPolicy(weight=w) for t, w in weights.items()
+    })
+    R = 400
+    grants = {t: 0 for t in weights}
+    clk = 0.0
+    t0 = time.perf_counter()
+    for _ in range(R):
+        clk += 0.01
+        for t in qc.plan_round(list(weights), now=clk):
+            grants[t] += 1
+    plan_s = time.perf_counter() - t0
+    w_max = max(weights.values())
+    fairness = {
+        t: {
+            "weight": w,
+            "grants": grants[t],
+            "chunks_per_round": round(grants[t] / R, 4),
+            "expected_share": round(w / w_max, 4),
+            "within_bound": bool(
+                grants[t] >= int(R * w / w_max) - 1
+            ),
+        }
+        for t, w in weights.items()
+    }
+
+    ladder_pol = QosPolicy(backlog_budget_s=0.5, limit_after=1,
+                           park_after=1, unpark_below_s=0.25,
+                           unpark_grace_s=0.0, shed_queue_depth=3)
+    qos_ctrl = QosController(default=QosPolicy(), eval_every_s=0.01,
+                             per_tenant={"victim": ladder_pol})
+    cc_small, cap_small = cc_tenant_tier(1 << 7, chunk_capacity=32)
+
+    def _bench_wait(pred, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return bool(pred())
+
+    def _small_chunks(seed):
+        from gelly_tpu import edge_stream_from_edges
+
+        e = np.random.default_rng(seed).integers(0, 1 << 7, (256, 2))
+        return list(edge_stream_from_edges(
+            [(int(a), int(b)) for a, b in e],
+            vertex_capacity=1 << 7, chunk_size=32,
+        ))
+
+    backlog_bounded = False
+    survivor_done = False
+    with obs_bus.scope() as bus:
+        ages = {}
+        bus.watermarks.backlog_age = lambda tid: ages.get(tid, 0.0)
+        eng = MultiTenantEngine(merge_every=1, qos=qos_ctrl,
+                                poll_s=0.01)
+        eng.add_tier("cc", cc_small, cap_small)
+        eng.admit("victim", "cc")
+        eng.admit("other", "cc")
+        vic = _small_chunks(1)
+        oth = _small_chunks(2)
+        eng.start()
+        try:
+            for ch in vic[:2]:
+                eng.submit("victim", ch)
+            for ch in oth[:2]:
+                eng.submit("other", ch)
+            _bench_wait(lambda: eng.position("victim") == 2
+                        and eng.position("other") == 2)
+            # Sustained over-budget backlog: limit, then park.
+            ages["victim"] = 10.0
+            ages["other"] = 10.0
+            _bench_wait(lambda: eng.qos_state("victim") == "parked")
+            # Pressure drains: auto un-park.
+            ages["victim"] = 0.0
+            ages["other"] = 0.0
+            _bench_wait(lambda: eng.qos_state("victim") in ("ok", "limited"))
+            # Overload again and bury the parked queue: shed.
+            ages["victim"] = 10.0
+            ages["other"] = 10.0
+            _bench_wait(lambda: eng.qos_state("victim") == "parked")
+            for ch in vic[2:8]:
+                eng.submit("victim", ch)
+            _bench_wait(lambda: eng.qos_state("victim") == "shed")
+            backlog_bounded = eng.queue_depth("victim") == 0
+            ages["other"] = 0.0
+            for ch in oth[2:]:
+                eng.submit("other", ch)
+            eng.finish("other")
+            survivor_done = _bench_wait(
+                lambda: eng.telemetry()["other"]["done"])
+        finally:
+            eng.stop()
+        qsnap = bus.snapshot()["counters"]
+    qos_block = {
+        "fairness": fairness,
+        "fairness_rounds": R,
+        "plan_round_us": round(plan_s / R * 1e6, 2),
+        "fairness_bound_ok": all(
+            f["within_bound"] for f in fairness.values()
+        ),
+        "rate_limited": int(qsnap.get("qos.rate_limited", 0)),
+        "parked": int(qsnap.get("qos.parked", 0)),
+        "unparked": int(qsnap.get("qos.unparked", 0)),
+        "shed": int(qsnap.get("qos.shed", 0)),
+        "chunks_dropped": int(qsnap.get("qos.chunks_dropped", 0)),
+        "backlog_bounded": bool(backlog_bounded),
+        "survivor_completed": bool(survivor_done),
+        # Policy decisions are host-independent control flow — there
+        # is no accelerator scaling claim to defer here.
+        "scaling_measurable": False,
+    }
+
     cores = available_cores()
     speedup64 = rows["64"]["speedup"]
     out = {
@@ -2847,6 +2975,7 @@ def bench_tenants(args) -> dict:
         ),
         **trace_info,
         "parity_ok": all(r["parity"] for r in rows.values()),
+        "qos": qos_block,
         "available_cores": cores,
         # The 3x-at-N=64 acceptance bar needs lanes that actually run
         # in parallel (vector units across tenants on an accelerator);

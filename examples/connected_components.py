@@ -5,8 +5,16 @@ Usage: python examples/connected_components.py [--checkpoint-dir=DIR]
            [--trace-out=PATH] [--shards=S]
            [--queries=cc,degrees,bipartiteness]
            [--serve=PORT | --connect=HOST:PORT] [--compressed] [--stats]
+           [--auth-token=TOKEN]
            [<edges path> <merge every chunks>]
 Prints (vertex, component) pairs after each merge window.
+
+``--auth-token=TOKEN`` (with ``--serve``/``--connect``) arms the wire's
+pre-shared-key handshake: the server answers a bare HELLO with an
+HMAC-SHA256 challenge and nothing but the handshake crosses an
+unauthenticated connection; the client proves the token inside its
+re-HELLO. Both sides must pass the same token (README "Multi-tenant
+serving", exactly-once multi-tenant wire).
 
 ``--stats`` (with ``--serve``) turns on serving-plane telemetry
 recording (``gelly_tpu.obs``): fold-dispatch / checkpoint-write /
@@ -79,13 +87,15 @@ from gelly_tpu.library.connected_components import (
 )
 
 
-def _serve_stream(port, vertex_capacity=1 << 16, chunk_capacity=4096):
+def _serve_stream(port, vertex_capacity=1 << 16, chunk_capacity=4096,
+                  auth_token=None):
     """An EdgeStream fed by the wire: raw-edge payloads from a
     ``--connect`` peer become padded identity chunks."""
     from gelly_tpu import EdgeStream, IdentityVertexTable, StreamContext
     from gelly_tpu.ingest import IngestServer
 
-    server = IngestServer(port=port, stop_on_bye=True).start()
+    server = IngestServer(port=port, stop_on_bye=True,
+                          auth_token=auth_token).start()
     print(f"# ingest server on port {server.port}; waiting for a "
           "--connect peer (stream ends at the client's BYE)")
     ctx = StreamContext(table=IdentityVertexTable(vertex_capacity),
@@ -106,7 +116,7 @@ def _wire_codec_plan():
     return connected_components(_WIRE_CAPACITY, codec="sparse")
 
 
-def _connect_main(target, rest, compressed=False):
+def _connect_main(target, rest, compressed=False, auth_token=None):
     """Stream the edge file (or the default data) to a --serve peer.
     With ``--compressed``, each chunk is reduced CLIENT-SIDE to its
     sparse spanning-forest pairs (the plan's ingest codec) and shipped
@@ -125,7 +135,7 @@ def _connect_main(target, rest, compressed=False):
         edges = sequence_default_edges()
         src = np.asarray([e[0] for e in edges], dtype=np.int64)
         dst = np.asarray([e[1] for e in edges], dtype=np.int64)
-    cli = IngestClient(host, int(port)).connect()
+    cli = IngestClient(host, int(port), auth_token=auth_token).connect()
     if compressed:
         from gelly_tpu.core.chunk import make_chunk
 
@@ -152,7 +162,7 @@ def _connect_main(target, rest, compressed=False):
 
 def _serve_compressed_main(port, merge_every, trace_out,
                            codec_workers=None, h2d_depth=None,
-                           merge_mode="auto"):
+                           merge_mode="auto", auth_token=None):
     """--serve --compressed: fold CLIENT-compressed payloads straight
     off the wire (``run_aggregation(precompressed=True)``) — a traced
     run shows zero ``compress`` spans on this side. The executor knobs
@@ -165,7 +175,8 @@ def _serve_compressed_main(port, merge_every, trace_out,
         connected_components,
     )
 
-    server = IngestServer(port=port, stop_on_bye=True).start()
+    server = IngestServer(port=port, stop_on_bye=True,
+                          auth_token=auth_token).start()
     print(f"# compressed ingest server on port {server.port}; waiting "
           "for a --connect ... --compressed peer (the client compresses; "
           "this side folds the payloads directly)")
@@ -281,6 +292,7 @@ def main(args):
     queries = None
     compressed = False
     stats = False
+    auth_token = None
     rest = []
     for a in args:
         if a.startswith("--checkpoint-dir="):
@@ -305,6 +317,8 @@ def main(args):
             compressed = True
         elif a == "--stats":
             stats = True
+        elif a.startswith("--auth-token="):
+            auth_token = a.split("=", 1)[1]
         else:
             rest.append(a)
     if ckpt_dir is not None and (
@@ -342,8 +356,15 @@ def main(args):
         print("# serving-plane telemetry recording ON — query live "
               "stats with: python -m gelly_tpu.obs.status "
               f"127.0.0.1:{serve}")
+    if auth_token is not None and serve is None and connect is None:
+        raise SystemExit(
+            "--auth-token arms the wire's pre-shared-key handshake; "
+            "pair it with --serve or --connect (both sides must pass "
+            "the same token)"
+        )
     if connect is not None:
-        return _connect_main(connect, rest, compressed=compressed)
+        return _connect_main(connect, rest, compressed=compressed,
+                             auth_token=auth_token)
     if serve is not None and (ckpt_dir is not None or shards is not None):
         raise SystemExit(
             "--serve ingests from the wire — it cannot also read a "
@@ -364,10 +385,10 @@ def main(args):
         return _serve_compressed_main(
             serve, arg(rest, 1, 4), trace_out,
             codec_workers=codec_workers, h2d_depth=h2d_depth,
-            merge_mode=merge_mode,
+            merge_mode=merge_mode, auth_token=auth_token,
         )
     if serve is not None:
-        stream, server = _serve_stream(serve)
+        stream, server = _serve_stream(serve, auth_token=auth_token)
     elif shards is not None:
         if not rest:
             raise SystemExit("--shards needs an edge file path argument")

@@ -78,6 +78,14 @@ from ..core.chunk import EdgeChunk
 from ..obs import bus as obs_bus
 from ..obs import tracing as obs_tracing
 from .aggregation import SummaryAggregation, _compiled_tenant_plan
+from .qos import (
+    QOS_LIMITED,
+    QOS_OK,
+    QOS_PARKED,
+    QOS_SHED,
+    AdmissionRefused,
+    QosController,
+)
 
 logger = logging.getLogger("gelly_tpu.tenants")
 
@@ -475,7 +483,8 @@ class _Tenant:
     __slots__ = ("tid", "tier", "lane", "queue", "source", "consumed",
                  "submitted", "finished", "done", "starved_windows",
                  "manager", "pending_state", "ready", "parked",
-                 "parked_window")
+                 "parked_window", "park_pending", "parked_state",
+                 "shed")
 
     def __init__(self, tid, tier: str, lane: int):
         self.tid = tid
@@ -503,6 +512,16 @@ class _Tenant:
         # taken at.
         self.parked = None
         self.parked_window = 0
+        # QoS degradation ladder bookkeeping: `park_pending` marks a
+        # tenant the controller parked whose lane is freed at the next
+        # safe point (a window boundary — mid-window parks on non-accum
+        # plans would lose window-local folds); `parked_state` holds
+        # the RAW running summary row host-side at the park, so an
+        # un-park can restore the lane bit-identically; `shed` marks a
+        # stream the controller closed (queue dropped, wire NACKed).
+        self.park_pending = False
+        self.parked_state = None
+        self.shed = False
         # False until admit() has installed the lane state and resume
         # position: a running scheduler must neither pull nor dispatch
         # a half-admitted tenant (it would fold into a fresh lane the
@@ -556,7 +575,8 @@ class MultiTenantEngine:
                  checkpoint_dir: str | None = None,
                  checkpoint_every: int = 1, resume: bool = False,
                  mesh=None, poll_s: float = 0.005,
-                 reclaim_after: int | None = None):
+                 reclaim_after: int | None = None,
+                 qos: QosController | None = None):
         if merge_every < 1:
             raise ValueError(f"merge_every must be >= 1, got {merge_every}")
         if checkpoint_every < 1:
@@ -576,6 +596,32 @@ class MultiTenantEngine:
         # widths previously only grew (O(log N) compiles); shrinking
         # back to a compiled width is a plan-cache hit.
         self.reclaim_after = reclaim_after
+        # The QoS policy plane (None = legacy uniform fair share):
+        # weighted-fair DRR in _round, the admission ceiling in admit()
+        # and the limit→park→shed degradation ladder in _qos_evaluate.
+        # With a controller installed the watermark ledgers run even
+        # without telemetry recording (_wmk_on) — backlog age IS the
+        # policy signal, not just a dashboard.
+        if qos is not None and not isinstance(qos, QosController):
+            raise TypeError(
+                f"qos must be a QosController, got {type(qos).__name__}"
+            )
+        self.qos = qos
+        self._qos_next_eval = 0.0
+        # Admissions deferred by the ceiling (admission="queue"):
+        # (tenant_id, tier, chunks) retried as pressure drains.
+        self._qos_waiting: deque = deque()
+        # Durability hooks: callables (tenant_id, position) fired AFTER
+        # each per-tenant durability point commits (checkpoint
+        # rotation, park/eviction final save, or — with no checkpoint
+        # dir — the window close). The ingest router registers the
+        # checkpoint-gated per-tenant wire ack here. Always fired
+        # outside the engine locks.
+        self.on_durable: list = []
+        # QoS transition hooks: callables (tenant_id, action, info) for
+        # "limit"/"clear"/"park"/"unpark"/"shed" — the router maps
+        # park/unpark/shed onto wire PAUSE/RESUME/NACK.
+        self.on_qos: list = []
         self.merge_every = merge_every
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
@@ -625,6 +671,34 @@ class MultiTenantEngine:
                             min_lanes=min_lanes, compressed=compressed),
             )
 
+    def _wmk_on(self) -> bool:
+        """True when the per-tenant watermark ledgers must run: QoS
+        consumes backlog ages as a POLICY signal, so a controller keeps
+        the ledgers on even when telemetry recording is off (histogram
+        publication stays telemetry-gated at each site)."""
+        return self.qos is not None or _telemetry_on()
+
+    def tenant_ids(self) -> list:
+        """Admitted tenant ids (the router's seq-seed enumeration)."""
+        with self._lock:
+            return list(self._tenants)
+
+    def qos_state(self, tenant_id) -> str:
+        """The tenant's QoS ladder state (``"ok"`` without a
+        controller)."""
+        return self.qos.state(tenant_id) if self.qos is not None else QOS_OK
+
+    def _active_backlog_age(self) -> float:
+        """Worst backlog age across ACTIVE (lane-holding, not-done)
+        tenants — the admission/un-park pressure signal. Parked
+        tenants' ledgers age by construction while held and must not
+        hold the admission door shut (or their own release)."""
+        wmk = obs_bus.get_bus().watermarks
+        with self._lock:
+            tids = [t.tid for t in self._tenants.values()
+                    if t.lane >= 0 and not t.done]
+        return max((wmk.backlog_age(tid) for tid in tids), default=0.0)
+
     def admit(self, tenant_id, tier: str, chunks=None) -> int:
         """Admit a tenant into ``tier``; returns its lane index.
 
@@ -637,7 +711,50 @@ class MultiTenantEngine:
         source is fast-forwarded past the recorded position (push-mode
         callers must replay from :meth:`position` themselves — the
         ingest router's ``resume_seq`` contract).
+
+        With a :class:`~gelly_tpu.engine.qos.QosController` configured
+        with ``admission_ceiling_s``, admission is refused (raises
+        :class:`~gelly_tpu.engine.qos.AdmissionRefused`) or queued
+        (returns ``-1``; the tenant is admitted automatically once
+        active backlog drains below the ceiling) while
+        ``tenants.backlog_age_max_s`` over ACTIVE tenants exceeds the
+        ceiling.
         """
+        qos = self.qos
+        if qos is not None and qos.admission_ceiling_s is not None:
+            age = self._active_backlog_age()
+            if age > qos.admission_ceiling_s:
+                bus = obs_bus.get_bus()
+                if qos.admission == "queue":
+                    with self._lock:
+                        if tenant_id in self._tenants or any(
+                            w[0] == tenant_id for w in self._qos_waiting
+                        ):
+                            raise ValueError(
+                                f"tenant {tenant_id!r} already admitted"
+                                " or queued"
+                            )
+                        if tier not in self._tiers:
+                            raise ValueError(
+                                f"unknown tier {tier!r} (registered: "
+                                f"{sorted(self._tiers)})"
+                            )
+                        self._qos_waiting.append((tenant_id, tier, chunks))
+                    bus.emit(
+                        "qos.admissions_queued",
+                        tenant=str(tenant_id),
+                        backlog_age_s=round(age, 6),
+                    )
+                    return -1
+                bus.emit(
+                    "qos.admissions_refused",
+                    tenant=str(tenant_id),
+                    backlog_age_s=round(age, 6),
+                )
+                raise AdmissionRefused(
+                    tenant_id, backlog_age_s=age,
+                    ceiling_s=qos.admission_ceiling_s,
+                )
         with self._lock:
             if tenant_id in self._tenants:
                 raise ValueError(f"tenant {tenant_id!r} already admitted")
@@ -707,7 +824,7 @@ class MultiTenantEngine:
             t.submitted = position
             t.source = source
             t.ready = True
-        if _telemetry_on():
+        if self._wmk_on():
             # Seed the per-tenant e2e ledger at the exactly-once resume
             # point: a resumed tenant's backlog re-ages from the
             # re-submitted chunks' arrival, never the wall clock.
@@ -738,7 +855,7 @@ class MultiTenantEngine:
         h = _normalize_chunk(chunk, batch.chunk_capacity)
         with self._lock:
             batch.check_template(h)
-            if _telemetry_on():
+            if self._wmk_on():
                 # Ingress stamp at the submit boundary, keyed by the
                 # chunk's dispatch-order position (queue is FIFO per
                 # tenant): the per-tenant e2e watermark's time zero.
@@ -776,7 +893,7 @@ class MultiTenantEngine:
             batch.agg.codec_payload_check(h)
         with self._lock:
             batch.check_payload_template(h)
-            if _telemetry_on():
+            if self._wmk_on():
                 obs_bus.get_bus().watermarks.stamp(
                     tenant_id, t.submitted)
             t.submitted += 1
@@ -828,10 +945,19 @@ class MultiTenantEngine:
             snap = tier.snapshot
             lane = t.lane
             width = tier.snapshot_lanes
-            parked = t.parked if lane < 0 else None
+            # The parked row answers while the tenant holds no lane OR
+            # while the published snapshot predates / doesn't cover the
+            # lane it was just un-parked onto (freshness guard: the row
+            # clears in _close_window once a covering snapshot lands).
+            parked = t.parked if (
+                t.parked is not None
+                and (lane < 0 or lane >= width
+                     or tier.snapshot_window <= t.parked_window)
+            ) else None
         if parked is not None:
-            # Evicted by idle-lane reclamation: the final snapshot row
-            # was parked host-side before the lane was reclaimed.
+            # Evicted by idle-lane reclamation (or QoS-parked): the
+            # snapshot row was parked host-side before the lane was
+            # freed.
             if v is None:
                 return jax.tree.map(np.asarray, parked)
             return jax.tree.map(lambda l: np.asarray(l)[v], parked)
@@ -878,6 +1004,7 @@ class MultiTenantEngine:
                 rows.append((t.tid, t.tier, t.lane, t.consumed,
                              len(t.queue), t.done, t.starved_windows,
                              win))
+        states = self.qos.states() if self.qos is not None else {}
         out = {}
         for tid, tier_name, lane, pos, depth, done, starved, win in rows:
             out[str(tid)] = {
@@ -889,6 +1016,7 @@ class MultiTenantEngine:
                 "starved_windows": starved,
                 "backlog_age_s": round(wmk.backlog_age(tid), 6),
                 "snapshot_window": win,
+                "qos_state": states.get(tid, QOS_OK),
             }
         return out
 
@@ -898,6 +1026,11 @@ class MultiTenantEngine:
         with self._lock:
             t = self._tenants[tenant_id]
             tier = self._tiers[t.tier]
+            if t.parked is not None and (
+                t.lane < 0 or t.lane >= tier.snapshot_lanes
+                or tier.snapshot_window <= t.parked_window
+            ):
+                return t.parked_window  # parked/evicted row answers
             if t.lane < 0:
                 return t.parked_window  # evicted: the parked row's window
             if t.lane >= tier.snapshot_lanes:
@@ -937,7 +1070,14 @@ class MultiTenantEngine:
     def drain(self) -> dict:
         """Run the scheduler INLINE until every admitted tenant is done
         (finite workloads / tests); returns ``{tenant_id: final
-        snapshot row}`` from the last closed window."""
+        snapshot row}`` from the last closed window.
+
+        Caveat: with a QoS controller, drain() converges only because
+        parked tenants un-park once the remaining active backlog
+        drains below their threshold; a tenant parked under a policy
+        with no un-park threshold (``backlog_budget_s=None`` +
+        ``unpark_below_s=None``) would hold its queue forever —
+        overloaded serving workloads belong on :meth:`start`."""
         self._run(until_idle=True)
         with self._lock:
             tids = list(self._tenants)
@@ -984,7 +1124,7 @@ class MultiTenantEngine:
                             batch.check_payload_template(h)
                         else:
                             batch.check_template(h)
-                        if _telemetry_on():
+                        if self._wmk_on():
                             obs_bus.get_bus().watermarks.stamp(
                                 t.tid, t.submitted)
                         t.submitted += 1
@@ -1023,6 +1163,8 @@ class MultiTenantEngine:
             bus.gauge("tenants.queue_depth", queued)
             if self.publish_staged_gauge:
                 bus.gauge("pipeline.staged_depth", queued)
+            if self.qos is not None:
+                self._qos_evaluate(bus)
             backlog_max = 0.0
             hb_due = hb is not None and hb.due()
             if _telemetry_on():
@@ -1038,18 +1180,32 @@ class MultiTenantEngine:
                     gauge_next = now + 0.5
                     wmk = bus.watermarks
                     with self._lock:
-                        tids = [t.tid for t in self._tenants.values()]
-                    for tid in tids:
+                        tids = [(t.tid, t.lane >= 0 and not t.done)
+                                for t in self._tenants.values()]
+                    for tid, active in tids:
                         # Every tenant, done ones included: a drained
                         # ledger publishes 0, so dashboards never show a
                         # finished tenant's last in-flight age forever.
                         age = wmk.backlog_age(tid)
-                        backlog_max = max(backlog_max, age)
+                        if active:
+                            # The headline max is over ACTIVE tenants
+                            # only: a parked tenant's ledger ages by
+                            # construction while held and must not pin
+                            # the admission/un-park pressure signal.
+                            backlog_max = max(backlog_max, age)
                         bus.gauge(f"tenants.t{tid}.backlog_age_s",
                                   round(age, 6))
                     bus.gauge("tenants.backlog_age_max_s",
                               round(backlog_max, 6))
             if hb_due:
+                extras = {}
+                if self.qos is not None:
+                    counts = self.qos.counts()
+                    extras = {
+                        "qos_limited": counts[QOS_LIMITED],
+                        "qos_parked": counts[QOS_PARKED],
+                        "qos_shed": counts[QOS_SHED],
+                    }
                 hb.tick(
                     tenants_active=len(live),
                     tenants_queue_depth=queued,
@@ -1059,6 +1215,7 @@ class MultiTenantEngine:
                     backlog_age_max_s=round(backlog_max, 3),
                     round_p99_ms=round(
                         bus.quantile("tenants.round_ms", 0.99), 3),
+                    **extras,
                 )
             if advanced:
                 continue
@@ -1110,12 +1267,29 @@ class MultiTenantEngine:
                 per_lane: list = [None] * width
                 took: list = []
                 starved_tenants: list = []
+                backlogged: list = []
                 for t in members:
+                    if t.park_pending:
+                        # Park decided but not yet executed (waiting for
+                        # the window boundary): hold the lane masked.
+                        continue
                     if t.queue:
-                        per_lane[t.lane] = t.queue.popleft()
-                        took.append(t)
+                        backlogged.append(t)
                     elif not t.finished and not t.done:
                         starved_tenants.append(t)
+                granted = None
+                if self.qos is not None and backlogged:
+                    # Deficit-round-robin over policy weights replaces
+                    # one-chunk-per-round uniformity. The controller's
+                    # lock is a leaf — safe inside the table lock. A
+                    # backlogged-but-ungranted tenant is NOT starved:
+                    # it has work and is being paced by policy.
+                    granted = self.qos.plan_round(
+                        [t.tid for t in backlogged])
+                for t in backlogged:
+                    if granted is None or t.tid in granted:
+                        per_lane[t.lane] = t.queue.popleft()
+                        took.append(t)
             if not took:
                 # No dispatch, no starvation: a starved window is a
                 # masked no-op lane IN a dispatch, so an idle serving
@@ -1155,13 +1329,17 @@ class MultiTenantEngine:
                 self.stats["chunks"] += len(took)
                 if starved:
                     self.stats["starved_lanes"] += starved
-            if telemetry:
+            if self._wmk_on():
                 # Ingress→fold for every chunk this round advanced
                 # (per-tenant histograms; stamps stay until durable).
+                # Histogram publication stays telemetry-gated; the
+                # ledger advance itself also feeds the QoS signal.
                 for t in took:
                     bus.watermarks.retire_fold(
-                        t.tid, t.consumed, bus=bus,
-                        prefix=f"tenants.t{t.tid}")
+                        t.tid, t.consumed,
+                        bus=bus if telemetry else None,
+                        prefix=(f"tenants.t{t.tid}"
+                                if telemetry else None))
             if starved:
                 bus.inc("tenants.starved_windows", starved)
             bus.inc("tenants.dispatches")
@@ -1179,6 +1357,220 @@ class MultiTenantEngine:
             if tier.chunks_in_window >= self.merge_every:
                 self._close_window(tier, bus, tracer)
         return any_dispatch
+
+    # -------------------------------------------------------- QoS ladder
+
+    def _qos_evaluate(self, bus) -> None:
+        """The rate-limited QoS pass (scheduler thread only): advance
+        every tenant's ladder state, execute pending parks at safe
+        points, retry queued admissions, publish the ``qos.*`` gauges
+        and fire ``on_qos`` hooks — all hook/bus work OUTSIDE the
+        engine locks."""
+        qos = self.qos
+        now = _time.monotonic()
+        wmk = obs_bus.get_bus().watermarks
+        with self._lock:
+            if now < self._qos_next_eval:
+                return
+            self._qos_next_eval = now + qos.eval_every_s
+            rows = [(t, len(t.queue)) for t in self._tenants.values()
+                    if t.ready and not t.done]
+            active = [t.tid for t, _ in rows if t.lane >= 0]
+        ages = {t.tid: wmk.backlog_age(t.tid) for t, _ in rows}
+        active_max = max((ages[tid] for tid in active), default=0.0)
+        events: list = []
+        for t, depth in rows:
+            action = qos.evaluate(
+                t.tid, backlog_age_s=ages[t.tid], queue_depth=depth,
+                active_backlog_max_s=active_max,
+            )
+            if action is None:
+                continue
+            info = {"backlog_age_s": round(ages[t.tid], 6),
+                    "queue_depth": depth}
+            if action == "limit":
+                bus.emit("qos.rate_limited", tenant=str(t.tid), **info)
+            elif action == "clear":
+                bus.emit("qos.limit_cleared", tenant=str(t.tid), **info)
+            elif action == "park":
+                with self._lock:
+                    t.park_pending = True
+                bus.emit("qos.parked", tenant=str(t.tid), **info)
+            elif action == "unpark":
+                self._unpark_tenant(t)
+                bus.emit("qos.unparked", tenant=str(t.tid), **info)
+            elif action == "shed":
+                info["chunks_dropped"] = self._shed_tenant(t, bus)
+                bus.emit("qos.shed", tenant=str(t.tid), **info)
+            events.append((t.tid, action, info))
+        # Parks decided above (or in earlier passes) execute only at a
+        # window boundary — a mid-window park would drop the lane's
+        # un-merged folds. Idle tiers (chunks_in_window == 0) are at a
+        # boundary RIGHT NOW; busy tiers park in _close_window.
+        with self._lock:
+            idle_tiers = [tr for tr in self._tiers.values()
+                          if tr.chunks_in_window == 0]
+        for tr in idle_tiers:
+            self._execute_parks(tr, bus)
+        self._retry_admissions(bus)
+        counts = qos.counts()
+        bus.gauge("qos.limited_tenants", counts[QOS_LIMITED])
+        bus.gauge("qos.parked_tenants", counts[QOS_PARKED])
+        bus.gauge("qos.shed_tenants", counts[QOS_SHED])
+        for tid, action, info in events:
+            self._fire_qos(tid, action, info)
+
+    def _execute_parks(self, tier: _Tier, bus) -> None:
+        """Physically park every ``park_pending`` member of ``tier``
+        (scheduler thread, window boundary only): snapshot the lane's
+        summary AND raw running state host-side, final-save through the
+        tenant's manager (the park is a durability point — the wire can
+        ack everything folded so far), then free the lane. The freed
+        width is reused by later admissions / un-parks; the next
+        ``_maybe_reclaim`` can shrink the stack."""
+        with self._lock:
+            pend = [t for t in self._tenants.values()
+                    if t.tier == tier.name and t.park_pending
+                    and t.lane >= 0 and t.ready]
+        if not pend:
+            return
+        batch = tier.batch
+        retired: list = []
+        with self._dispatch_lock:
+            if batch.plan is None:
+                with self._lock:
+                    for t in pend:
+                        t.lane = -1
+                        t.park_pending = False
+                return
+            src = batch.state if batch.accum else batch.global_
+            snap = batch.plan.snapshot(src)
+            jax.block_until_ready(snap)
+            parked_rows = {
+                t.tid: jax.tree.map(
+                    lambda l, lane=t.lane: np.asarray(l[lane]), snap)
+                for t in pend
+            }
+            raw_rows = {
+                t.tid: jax.tree.map(np.asarray, batch.slice_lane(t.lane))
+                for t in pend
+            }
+            for t in pend:
+                if t.manager is not None:
+                    pos = t.consumed
+                    t.manager.save(
+                        batch.slice_lane(t.lane), pos,
+                        meta={"tenant": str(t.tid), "tier": tier.name,
+                              "window": tier.windows_closed,
+                              "qos_parked": True},
+                    )
+                    retired.append((t.tid, pos))
+            with self._lock:
+                for t in pend:
+                    t.parked = parked_rows[t.tid]
+                    t.parked_state = raw_rows[t.tid]
+                    t.parked_window = tier.windows_closed
+                    t.lane = -1
+                    t.park_pending = False
+        for tid, pos in retired:
+            self._notify_durable(tid, pos, bus)
+
+    def _unpark_tenant(self, t: _Tenant) -> None:
+        """Re-seat a parked tenant on a fresh lane, restoring the raw
+        running state captured at park time bit-identically. Lane
+        choice and assignment happen in ONE locked block so a
+        concurrent ``admit()`` can never hand out the same lane."""
+        with self._lock:
+            if t.lane >= 0:
+                return
+            lane = 1 + max(
+                (x.lane for x in self._tenants.values()
+                 if x.tier == t.tier), default=-1,
+            )
+            t.lane = lane
+            state = t.parked_state
+        batch = self._tiers[t.tier].batch
+        with self._dispatch_lock:
+            batch.ensure_lanes(lane + 1)
+            if state is not None:
+                batch.set_lane(lane, state)
+        # t.parked stays for query continuity until a window close
+        # covers the new lane (the freshness guard in query()).
+        self._work.set()
+
+    def _shed_tenant(self, t: _Tenant, bus) -> int:
+        """Close a tenant's stream: drop its queued (never-folded)
+        chunks, mark it finished+shed. The folded prefix stays
+        queryable from its parked/snapshot row; the wire maps this onto
+        a typed NACK. Returns the dropped-chunk count."""
+        with self._lock:
+            dropped = len(t.queue)
+            t.queue.clear()
+            t.finished = True
+            t.shed = True
+            t.park_pending = False
+        if dropped:
+            bus.inc("qos.chunks_dropped", dropped)
+        obs_bus.get_bus().watermarks.drop(t.tid)
+        self._work.set()
+        return dropped
+
+    def _retry_admissions(self, bus) -> None:
+        """Admit ONE queued tenant per QoS pass once active pressure is
+        back under the ceiling (one at a time: each admission adds
+        load, so the next pass re-reads pressure before the next
+        waiter)."""
+        qos = self.qos
+        if qos.admission_ceiling_s is None:
+            return
+        with self._lock:
+            if not self._qos_waiting:
+                return
+        if self._active_backlog_age() > qos.admission_ceiling_s:
+            return
+        with self._lock:
+            if not self._qos_waiting:
+                return
+            tenant_id, tier, chunks = self._qos_waiting.popleft()
+        try:
+            lane = self.admit(tenant_id, tier, chunks=chunks)
+        except ValueError:
+            logger.exception(
+                "queued admission for tenant %r failed", tenant_id)
+            return
+        if lane >= 0:
+            bus.emit("qos.admissions_resumed", tenant=str(tenant_id))
+
+    def _notify_durable(self, tenant_id, position: int, bus) -> None:
+        """One tenant's durability point: retire the e2e ledger and
+        fire the ``on_durable`` hooks (the router's checkpoint-gated
+        wire acks). MUST be called outside the engine locks — hooks do
+        socket writes."""
+        telemetry = _telemetry_on()
+        if self._wmk_on():
+            bus.watermarks.retire_durable(
+                tenant_id, position,
+                bus=bus if telemetry else None,
+                prefix=f"tenants.t{tenant_id}" if telemetry else None,
+            )
+        for fn in list(self.on_durable):
+            try:
+                fn(tenant_id, position)
+            except Exception:
+                logger.exception(
+                    "on_durable hook failed for tenant %r at %d",
+                    tenant_id, position,
+                )
+
+    def _fire_qos(self, tenant_id, action: str, info: dict) -> None:
+        for fn in list(self.on_qos):
+            try:
+                fn(tenant_id, action, info)
+            except Exception:
+                logger.exception(
+                    "on_qos hook failed for tenant %r (%s)",
+                    tenant_id, action,
+                )
 
     def _close_window(self, tier: _Tier, bus, tracer) -> None:
         batch = tier.batch
@@ -1216,6 +1608,14 @@ class MultiTenantEngine:
             tier.snapshot = snap
             tier.snapshot_lanes = snap_lanes
             tier.snapshot_window = tier.windows_closed
+            for t in self._tenants.values():
+                # A fresh snapshot covering an un-parked tenant's new
+                # lane supersedes its parked row — drop the host copies
+                # so queries read the live lane again.
+                if (t.tier == tier.name and t.parked is not None
+                        and 0 <= t.lane < snap_lanes):
+                    t.parked = None
+                    t.parked_state = None
         if tracer is not None:
             tracer.span("merge_emit", f"tenants/{tier.name}", t0,
                         tier=tier.name, window=tier.windows_closed)
@@ -1223,20 +1623,25 @@ class MultiTenantEngine:
                 and tier.windows_closed - tier.last_ckpt_window
                 >= self.checkpoint_every):
             self._checkpoint_tier(tier)
-        elif self.checkpoint_dir is None and _telemetry_on():
+        elif self.checkpoint_dir is None and (self._wmk_on()
+                                              or self.on_durable):
             # No durability point configured: the window close IS the
-            # retirement point — drain the tier's e2e ledgers so the
-            # watermark tracks fold retirement instead of growing
-            # forever.
+            # retirement point — drain the tier's e2e ledgers (and fire
+            # on_durable hooks) so the watermark tracks fold retirement
+            # instead of growing forever. With a QoS controller this
+            # MUST run even when telemetry is off, or every tenant's
+            # backlog age grows without bound and parks the fleet.
             with self._lock:
                 members = [(t.tid, t.consumed)
                            for t in self._tenants.values()
                            if t.tier == tier.name]
             for tid, pos in members:
-                bus.watermarks.retire_durable(
-                    tid, pos, bus=bus, prefix=f"tenants.t{tid}")
+                self._notify_durable(tid, pos, bus)
         if self.reclaim_after is not None:
             self._maybe_reclaim(tier, bus, tracer)
+        if self.qos is not None:
+            # Window boundary = the safe point for pending parks.
+            self._execute_parks(tier, bus)
 
     def _checkpoint_tier(self, tier: _Tier) -> None:
         batch = tier.batch
@@ -1257,7 +1662,7 @@ class MultiTenantEngine:
                     if t.tier == tier.name and t.manager is not None
                     and t.lane >= 0
                 ]
-            telemetry = _telemetry_on()
+            saved: list = []
             for t, position in members:
                 t_h = _time.perf_counter()
                 t.manager.save(
@@ -1269,13 +1674,16 @@ class MultiTenantEngine:
                 obs_bus.publish_checkpoint(
                     b, "tenants", t.manager.path_for(position), t0=t_h,
                 )
-                if telemetry:
-                    # The per-tenant durability point: ingress→durable
-                    # retires and the tenant's low watermark advances.
-                    b.watermarks.retire_durable(
-                        t.tid, position, bus=b,
-                        prefix=f"tenants.t{t.tid}")
+                saved.append((t.tid, position))
         tier.last_ckpt_window = tier.windows_closed
+        # The per-tenant durability point: ingress→durable retires, the
+        # low watermark advances and the router's checkpoint-gated wire
+        # acks fire — OUTSIDE the dispatch lock (hooks do socket
+        # writes; the saves above already dominate, so an ack can never
+        # precede its durability).
+        b = obs_bus.get_bus()
+        for tid, position in saved:
+            self._notify_durable(tid, position, b)
 
     def _maybe_reclaim(self, tier: _Tier, bus, tracer) -> None:
         """Idle-lane reclamation (called at every window close when
@@ -1344,6 +1752,7 @@ class MultiTenantEngine:
                 )
                 for t in evicted
             }
+            final_saves: list = []
             for t in evicted:
                 if t.manager is not None:
                     t.manager.save(
@@ -1352,6 +1761,7 @@ class MultiTenantEngine:
                               "window": tier.windows_closed,
                               "evicted": True},
                     )
+                    final_saves.append((t.tid, t.consumed))
             keep_lanes = [t.lane for t in live]
             batch.shrink(keep_lanes, target)
             # Published snapshot rebuilt in the NEW lane order (fresher
@@ -1383,7 +1793,12 @@ class MultiTenantEngine:
                     tier.snapshot_lanes = 0
                 self.stats["reclaims"] += 1
                 self.stats["lanes_reclaimed"] += freed
-        if _telemetry_on():
+        # The evicted tenants' final saves are durability points too:
+        # fire on_durable (router acks their folded tails) BEFORE
+        # dropping the ledgers.
+        for tid, pos in final_saves:
+            self._notify_durable(tid, pos, bus)
+        if self._wmk_on():
             # Evicted tenants fold nothing further: their e2e ledgers
             # (already drained to the final checkpoint) are dropped so
             # the max-backlog watermark never counts a parked row.

@@ -49,8 +49,10 @@ from .server import IngestServer, TenantRouter
 from .wire import (
     FrameError,
     pack_frame,
+    pack_json,
     pack_payload,
     read_frame,
+    unpack_json,
     unpack_payload,
 )
 
@@ -65,8 +67,10 @@ __all__ = [
     "edge_payload",
     "edge_stream_from_sharded_file",
     "pack_frame",
+    "pack_json",
     "pack_payload",
     "read_frame",
+    "unpack_json",
     "unpack_payload",
     "write_binary_edges",
 ]

@@ -12,6 +12,16 @@ The sending half of ``ingest/server.py``'s delivery contract:
   double-folding acked chunks";
 - **PAUSE/RESUME** frames gate :meth:`send` (gauge-driven
   backpressure); REJECT frames rewind and retransmit in place.
+- **Per-tenant sequence spaces** (``tenant_streams=True``): one
+  connection multiplexes N tenants, each with its own seq space,
+  resend buffer partition, acks, policy holds (a tenant-scoped PAUSE
+  from a QoS park blocks only that tenant's sends) and shed state (a
+  typed NACK is terminal: further sends for that tenant raise).
+  WELCOME carries the per-tenant expected-seq map plus park/pause
+  state, so a reconnecting client holds a held stream BEFORE its first
+  frame, not at the next backpressure poll.
+- **Pre-shared-key auth** (``auth_token=``): the handshake answers the
+  server's AUTH_CHALLENGE nonce with an HMAC-SHA256 proof.
 
 A background reader thread (``gelly-ingest-client-rx``) owns every
 incoming frame; protocol state is lock-guarded and ack progress is
@@ -20,6 +30,7 @@ signalled through a condition variable (:meth:`flush` waits on it).
 
 from __future__ import annotations
 
+import hmac
 import logging
 import socket
 import threading
@@ -62,20 +73,32 @@ class IngestClient:
 
     def __init__(self, host: str, port: int, *,
                  connect_timeout: float = 5.0,
-                 send_pause_timeout: float = 30.0):
+                 send_pause_timeout: float = 30.0,
+                 auth_token: str | None = None,
+                 tenant_streams: bool = False):
         self.host = host
         self.port = port
         self.connect_timeout = connect_timeout
         self.send_pause_timeout = send_pause_timeout
+        # Pre-shared key for the server's AUTH_CHALLENGE (None = open).
+        self.auth_token = auth_token
+        # Per-tenant sequence spaces (must match the server's mode).
+        self.tenant_streams = bool(tenant_streams)
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._send_lock = threading.Lock()
-        # seq -> framed bytes, pruned as acks arrive (insertion order =
-        # seq order, so a rewind replays a contiguous suffix).
-        self._unacked: dict[int, bytes] = {}
-        self._next_seq = 0
-        self._acked = 0
+        # (stream_key, seq) -> framed bytes, pruned as (scoped) acks
+        # arrive. stream_key None = the legacy single stream; an int =
+        # one tenant's seq space (tenant_streams mode).
+        self._unacked: dict = {}
+        # Per-stream next seq / acked position, same keying.
+        self._next: dict = {None: 0}
+        self._ackd: dict = {None: 0}
+        # Tenants held by a tenant-scoped PAUSE (QoS park) and streams
+        # shed by a typed NACK (key None = the whole legacy stream).
+        self._paused_tenants: set = set()
+        self._shed: dict = {}
         self._closed = False
         self._rx_error: BaseException | None = None
         # Set = clear to send; PAUSE clears it, RESUME sets it.
@@ -114,6 +137,24 @@ class IngestClient:
             ftype, seq, _payload = wire.read_frame(recv)
             if ftype == wire.WELCOME:
                 break
+            if ftype == wire.AUTH_CHALLENGE:
+                if self.auth_token is None:
+                    raise IngestError(
+                        "server requires a pre-shared auth token — "
+                        "construct IngestClient(auth_token=...) with "
+                        "the server's key"
+                    )
+                proof = hmac.new(
+                    self.auth_token.encode(), bytes(_payload), "sha256",
+                ).hexdigest()
+                self._raw_send(wire.pack_frame(
+                    wire.HELLO, 0, wire.pack_json({"auth": proof})))
+                continue
+            if ftype == wire.AUTH_FAIL:
+                raise IngestError(
+                    "authentication failed (AUTH_FAIL) — wrong or "
+                    "missing auth token"
+                )
             if ftype == wire.PAUSE:
                 self._resume_evt.clear()
             elif ftype == wire.RESUME:
@@ -127,8 +168,30 @@ class IngestClient:
                 )
         # The handshake left _resume_evt reflecting THIS connection's
         # backpressure state (a dead connection's teardown always sets
-        # it, so no stale PAUSE can leak in from before).
-        self._rewind_to(seq)
+        # it, so no stale PAUSE can leak in from before). WELCOME's
+        # control body is authoritative on top of that — apply the
+        # pause/park/shed state BEFORE any rewind/replay, so a client
+        # reconnecting into a held stream holds IMMEDIATELY instead of
+        # blasting frames until the next backpressure poll.
+        info = _ctl(_payload)
+        if "paused" in info:
+            if info["paused"]:
+                self._resume_evt.clear()
+            else:
+                self._resume_evt.set()
+        with self._lock:
+            self._paused_tenants = {
+                int(x) for x in info.get("paused_tenants", ())
+            }
+            for x in info.get("shed_tenants", ()):
+                self._shed.setdefault(int(x), "shed")
+        if self.tenant_streams:
+            self._rewind_streams({
+                int(k): int(v)
+                for k, v in info.get("streams", {}).items()
+            })
+        else:
+            self._rewind_to(seq)
         self._rx_thread = threading.Thread(
             target=self._reader_loop, args=(sock,), daemon=True,
             name="gelly-ingest-client-rx",
@@ -168,31 +231,76 @@ class IngestClient:
 
     # ------------------------------------------------------------ sending
 
-    def send(self, payload: dict, *, compressed: bool = False) -> int:
+    def send(self, payload: dict, *, compressed: bool = False,
+             tenant=None) -> int:
         """Frame + transmit one payload dict; returns its seq. Blocks
         while the server holds the stream PAUSEd (backpressure).
         ``compressed=True`` marks the payload as PRE-COMPRESSED (a
         codec ``host_compress`` output) — it rides the same seq space
         and resend buffer, framed ``DATA_COMPRESSED`` so the server
-        admits it with zero server-side compress work."""
+        admits it with zero server-side compress work.
+
+        In ``tenant_streams`` mode the frame rides the TENANT's seq
+        space: pass ``tenant=`` or include a ``"tenant"`` entry in the
+        payload. A tenant-scoped PAUSE (QoS park) blocks only that
+        tenant's sends; a shed tenant's sends raise."""
         faults_mod.inject("ingest")
+        key = None
+        if self.tenant_streams:
+            wt = payload.get("tenant") if tenant is None else tenant
+            if wt is None:
+                raise IngestError(
+                    "tenant_streams client: pass tenant= or include a "
+                    "'tenant' entry in the payload"
+                )
+            key = int(np.asarray(wt).reshape(-1)[0])
+            if "tenant" not in payload:
+                payload = dict(payload)
+                payload["tenant"] = np.asarray([key], dtype=np.int64)
         if not self._resume_evt.wait(self.send_pause_timeout):
             raise IngestError(
                 f"stream PAUSEd longer than {self.send_pause_timeout}s — "
                 "is the consumer stalled past the backpressure window?"
             )
+        if key is not None:
+            self._wait_tenant_flow(key)
         ftype = wire.DATA_COMPRESSED if compressed else wire.DATA
         with self._lock:
             self._raise_rx_error_locked()
-            seq = self._next_seq
+            if key in self._shed:
+                raise IngestError(
+                    f"stream {'(default)' if key is None else key} was "
+                    f"shed by the server ({self._shed[key]}); the "
+                    "folded prefix below the NACK's durable position "
+                    "is safe — nothing further will be accepted"
+                )
+            seq = self._next.setdefault(key, 0)
             frame = wire.pack_frame(
                 ftype, seq, wire.pack_payload(payload)
             )
-            self._unacked[seq] = frame
-            self._next_seq = seq + 1
+            self._unacked[(key, seq)] = frame
+            self._next[key] = seq + 1
         self._raw_send(frame)
         obs_bus.get_bus().inc("ingest.frames_sent")
         return seq
+
+    def _wait_tenant_flow(self, key: int) -> None:
+        """Block while ``key``'s stream is held by a tenant-scoped
+        PAUSE (QoS park). A shed notice or reader death unblocks (the
+        locked checks in :meth:`send` raise the right error)."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: (key not in self._paused_tenants
+                         or key in self._shed
+                         or self._rx_error is not None),
+                timeout=self.send_pause_timeout,
+            )
+            if not ok:
+                raise IngestError(
+                    f"tenant {key} held (PAUSEd) longer than "
+                    f"{self.send_pause_timeout}s — parked by QoS while "
+                    "its backlog drains?"
+                )
 
     def send_compressed(self, payload: dict) -> int:
         """:meth:`send` with ``compressed=True`` — the client-side leg
@@ -255,26 +363,60 @@ class IngestClient:
         return json.loads(payload.decode("utf-8"))
 
     def flush(self, timeout: float = 30.0) -> int:
-        """Wait until the server has acked every sent frame; returns
-        the acked seq. :class:`IngestError` on timeout."""
+        """Wait until the server has acked every sent frame (every
+        NON-SHED stream in tenant mode: a shed tenant's tail will
+        never be acked and must not hang the flush); returns the acked
+        seq (summed across tenants in tenant mode).
+        :class:`IngestError` on timeout."""
         with self._cv:
-            ok = self._cv.wait_for(
-                lambda: (self._acked >= self._next_seq
-                         or self._rx_error is not None),
-                timeout=timeout,
-            )
+            ok = self._cv.wait_for(self._flush_done_locked,
+                                   timeout=timeout)
             self._raise_rx_error_locked()
             if not ok:
                 raise IngestError(
                     f"flush timed out with {len(self._unacked)} frame(s) "
-                    f"unacked (sent {self._next_seq}, acked {self._acked})"
+                    "unacked"
                 )
-            return self._acked
+            return self._acked_locked()
+
+    def _flush_done_locked(self) -> bool:
+        if self._rx_error is not None:
+            return True
+        for key, n in list(self._next.items()):
+            if key in self._shed:
+                continue
+            if self._ackd.get(key, 0) < n:
+                return False
+        return True
+
+    def _acked_locked(self) -> int:
+        if self.tenant_streams:
+            return sum(v for k, v in list(self._ackd.items())
+                       if k is not None)
+        return self._ackd.get(None, 0)
 
     @property
     def acked(self) -> int:
         with self._lock:
-            return self._acked
+            return self._acked_locked()
+
+    def acked_for(self, tenant) -> int:
+        """One tenant's acked wire position (tenant_streams mode)."""
+        with self._lock:
+            return self._ackd.get(int(tenant), 0)
+
+    def tenant_paused(self, tenant) -> bool:
+        """True while the tenant's stream is held by a tenant-scoped
+        PAUSE (QoS park)."""
+        with self._lock:
+            return int(tenant) in self._paused_tenants
+
+    @property
+    def shed_tenants(self) -> dict:
+        """``{stream_key: reason}`` for streams the server shed (key
+        None = the legacy single stream)."""
+        with self._lock:
+            return dict(self._shed)
 
     @property
     def unacked_count(self) -> int:
@@ -302,26 +444,94 @@ class IngestClient:
             ) from e
 
     def _rewind_to(self, server_next: int) -> None:
-        """Align with the server's expected seq after a (re)connect:
-        prune frames the server already staged, retransmit the rest."""
+        """Align the legacy single stream with the server's expected
+        seq after a (re)connect: prune frames the server already
+        staged, retransmit the rest."""
         with self._lock:
-            if server_next > self._next_seq:
+            if server_next > self._next.get(None, 0):
                 raise IngestError(
                     f"server expects seq {server_next} but only "
-                    f"{self._next_seq} frames were ever sent — wrong "
-                    "server / stream?"
+                    f"{self._next.get(None, 0)} frames were ever sent — "
+                    "wrong server / stream?"
                 )
-            if server_next < self._acked:
+            if server_next < self._ackd.get(None, 0):
                 raise IngestError(
                     f"server rewound below the acked position "
-                    f"({server_next} < {self._acked}) — acked state was "
-                    "lost; refusing to guess at consistency"
+                    f"({server_next} < {self._ackd.get(None, 0)}) — "
+                    "acked state was lost; refusing to guess at "
+                    "consistency"
                 )
-            self._acked = server_next
-            for seq in [s for s in self._unacked if s < server_next]:
-                del self._unacked[seq]
-            replay = [self._unacked[s] for s in sorted(self._unacked)]
+            self._ackd[None] = server_next
+            for k in [k for k in self._unacked
+                      if k[0] is None and k[1] < server_next]:
+                del self._unacked[k]
+            replay = [self._unacked[k] for k in sorted(
+                (k for k in self._unacked if k[0] is None),
+                key=lambda k: k[1])]
             self._cv.notify_all()
+        for frame in replay:
+            self._raw_send(frame)
+        if replay:
+            obs_bus.get_bus().inc("ingest.frames_resent", len(replay))
+
+    def _rewind_tenant(self, tid: int, server_next: int) -> None:
+        """Per-tenant :meth:`_rewind_to` (tenant_streams mode): align
+        one tenant's seq space with the server's expected position and
+        retransmit its buffered suffix (never for a shed stream — the
+        server would only NACK the replay)."""
+        with self._lock:
+            if server_next > self._next.get(tid, 0):
+                raise IngestError(
+                    f"server expects seq {server_next} for tenant {tid} "
+                    f"but only {self._next.get(tid, 0)} frames were "
+                    "ever sent — wrong server / stream?"
+                )
+            if server_next < self._ackd.get(tid, 0):
+                raise IngestError(
+                    f"server rewound tenant {tid} below the acked "
+                    f"position ({server_next} < {self._ackd.get(tid, 0)})"
+                    " — acked state was lost; refusing to guess at "
+                    "consistency"
+                )
+            self._ackd[tid] = server_next
+            for k in [k for k in self._unacked
+                      if k[0] == tid and k[1] < server_next]:
+                del self._unacked[k]
+            replay = [] if tid in self._shed else [
+                self._unacked[k] for k in sorted(
+                    (k for k in self._unacked if k[0] == tid),
+                    key=lambda k: k[1])
+            ]
+            self._cv.notify_all()
+        for frame in replay:
+            self._raw_send(frame)
+        if replay:
+            obs_bus.get_bus().inc("ingest.frames_resent", len(replay))
+
+    def _rewind_streams(self, server_streams: dict) -> None:
+        """Tenant-mode (re)connect alignment: rewind every tenant seen
+        locally OR named in WELCOME's per-tenant expected-seq map. A
+        tenant the server has no record of rewinds to 0 (full replay);
+        a server position below our acked state raises — same
+        consistency refusal as the single-stream path."""
+        with self._lock:
+            tids = {k[0] for k in self._unacked if k[0] is not None}
+            tids.update(k for k in self._next if k is not None)
+            tids.update(server_streams)
+        for tid in sorted(tids):
+            self._rewind_tenant(tid, server_streams.get(tid, 0))
+
+    def _retransmit_all(self) -> None:
+        """Server-requested resync (a CRC-failed frame in tenant mode
+        has no attributable stream, so no single expect can be named):
+        retransmit EVERY buffered frame of every non-shed stream.
+        Duplicates are dropped + re-acked server-side, so over-sending
+        is always safe; deleting here never is."""
+        with self._lock:
+            replay = [self._unacked[k] for k in sorted(
+                (k for k in self._unacked if k[0] not in self._shed),
+                key=lambda k: (str(k[0]), k[1]))
+            ]
         for frame in replay:
             self._raw_send(frame)
         if replay:
@@ -337,28 +547,82 @@ class IngestClient:
                 except (wire.FrameError, _SocketGone):
                     return
                 if ftype == wire.ACK:
+                    ctl = _ctl(_payload)
+                    scope = ctl.get("tenant")
+                    key = None if scope is None else int(scope)
                     with self._lock:
-                        if seq > self._acked:
-                            self._acked = seq
-                        for s in [s for s in self._unacked if s < seq]:
-                            del self._unacked[s]
+                        if seq > self._ackd.get(key, 0):
+                            self._ackd[key] = seq
+                        for k in [k for k in self._unacked
+                                  if k[0] == key and k[1] < seq]:
+                            del self._unacked[k]
                         self._cv.notify_all()
                 elif ftype == wire.PAUSE:
                     bus.inc("ingest.pauses_received")
-                    self._resume_evt.clear()
+                    ctl = _ctl(_payload)
+                    scope = ctl.get("tenant")
+                    if scope is not None:
+                        # Tenant-scoped flow stop (QoS park): only that
+                        # stream's senders hold; others keep flowing.
+                        with self._lock:
+                            self._paused_tenants.add(int(scope))
+                            self._cv.notify_all()
+                    else:
+                        self._resume_evt.clear()
                 elif ftype == wire.RESUME:
-                    self._resume_evt.set()
+                    ctl = _ctl(_payload)
+                    scope = ctl.get("tenant")
+                    if scope is not None:
+                        with self._lock:
+                            self._paused_tenants.discard(int(scope))
+                            self._cv.notify_all()
+                    else:
+                        self._resume_evt.set()
                 elif ftype == wire.REJECT:
                     # Server refused a frame (CRC / gap): rewind to its
-                    # expected seq and retransmit in place.
+                    # expected seq and retransmit in place. A tenant-mode
+                    # CRC failure has no attributable stream, so the
+                    # server asks for a full resync instead of naming an
+                    # expected seq.
                     bus.inc("ingest.rejects_received")
+                    ctl = _ctl(_payload)
                     try:
-                        self._rewind_to(seq)
+                        if ctl.get("resync"):
+                            self._retransmit_all()
+                        elif ctl.get("tenant") is not None:
+                            self._rewind_tenant(int(ctl["tenant"]), seq)
+                        else:
+                            self._rewind_to(seq)
                     except IngestError as e:
                         with self._lock:
                             self._rx_error = e
                             self._cv.notify_all()
                         return
+                elif ftype == wire.NACK:
+                    # Terminal stream refusal (QoS shed): seq is the
+                    # tenant's durable position — below it is folded,
+                    # at/above it is dropped and will never be acked.
+                    bus.inc("ingest.nacks_received")
+                    ctl = _ctl(_payload)
+                    scope = ctl.get("tenant")
+                    key = None if scope is None else int(scope)
+                    reason = str(ctl.get("reason", "shed"))
+                    with self._lock:
+                        self._shed[key] = reason
+                        if key is not None:
+                            self._paused_tenants.discard(key)
+                        self._cv.notify_all()
+                    logger.warning(
+                        "ingest stream shed by server (tenant=%s, "
+                        "reason=%s, durable=%d)", scope, reason, seq,
+                    )
+                elif ftype == wire.AUTH_FAIL:
+                    with self._lock:
+                        self._rx_error = IngestError(
+                            "server refused authentication (AUTH_FAIL)"
+                        )
+                        self._cv.notify_all()
+                    return
                 elif ftype == wire.STATS:
                     with self._lock:
                         self._stats_payload = _payload
@@ -371,6 +635,7 @@ class IngestClient:
             # longer be lifted by this (dead) connection.
             self._resume_evt.set()
             with self._lock:
+                self._paused_tenants.clear()
                 self._cv.notify_all()
 
     def _raise_rx_error_locked(self) -> None:
@@ -397,6 +662,19 @@ class IngestClient:
 
 class _SocketGone(Exception):
     pass
+
+
+def _ctl(payload: bytes) -> dict:
+    """Decode an optional control-JSON envelope on a server frame.
+    Legacy servers send empty payloads on ACK/PAUSE/RESUME/REJECT;
+    malformed JSON degrades to the unscoped (legacy) interpretation
+    rather than killing the reader."""
+    if not payload:
+        return {}
+    try:
+        return wire.unpack_json(payload)
+    except wire.FrameError:
+        return {}
 
 
 def _blocking_recv(sock, timeout: float):

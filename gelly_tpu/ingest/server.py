@@ -43,6 +43,23 @@ Delivery contract:
   ignores PAUSE) until the depth drains to ``low_water``, then RESUME.
   Engagements are published as ``ingest.backpressure_engaged`` events
   and the ``ingest.paused`` gauge.
+- **Per-tenant sequence spaces** (``tenant_streams=True``). One
+  connection multiplexes N tenants: each DATA frame's ``"tenant"``
+  payload entry selects a per-tenant sequence space
+  (``[next_expected, acked, durable]``), WELCOME carries the whole
+  per-tenant expected-seq map (plus park/pause/shed state, so a
+  reconnecting client holds a parked tenant's stream IMMEDIATELY, not
+  at the next backpressure poll), and ACK/REJECT/PAUSE/RESUME/NACK
+  frames carry a ``{"tenant": ...}`` JSON envelope scoping them to one
+  stream. ``ack(pos, tenant=tid)`` is the checkpoint-gated per-tenant
+  ack the :class:`TenantRouter` fires from the engine's ``on_durable``
+  rotation; a QoS-shed tenant's frames are refused with a typed NACK
+  carrying its durable position.
+- **Pre-shared-key auth** (``auth_token=``). The server answers the
+  first bare HELLO with an AUTH_CHALLENGE nonce; the client re-HELLOs
+  with ``{"auth": hex(HMAC-SHA256(token, nonce))}``; anything else —
+  or any non-handshake frame before authentication — gets a typed
+  AUTH_FAIL and the connection closes (``ingest.auth_failures``).
 - **Live introspection (STATS).** A ``STATS`` frame — on a dedicated
   connection (``obs.status.fetch_stats`` / ``python -m
   gelly_tpu.obs.status HOST:PORT``) or interleaved on the data
@@ -61,7 +78,9 @@ Delivery contract:
 
 from __future__ import annotations
 
+import hmac
 import logging
+import secrets
 import socket
 import threading
 import time
@@ -132,8 +151,31 @@ class IngestServer:
                  low_water: int | None = None, ack_every: int = 1,
                  auto_ack: bool = True, resume_seq: int = 0,
                  pause_poll_s: float = 0.005, stop_on_bye: bool = False,
-                 stats_fields=None):
+                 stats_fields=None, auth_token: str | None = None,
+                 tenant_streams: bool = False,
+                 resume_seqs: dict | None = None):
         self.host = host
+        # Pre-shared-key HELLO auth (None = open, loopback default).
+        self.auth_token = auth_token
+        # Per-tenant sequence spaces: DATA frames carry a "tenant"
+        # payload entry and seq numbers are scoped per tenant.
+        self.tenant_streams = bool(tenant_streams)
+        # {tenant_id: [next_expected, acked, durable]} — list cells so
+        # the conn loop's updates are plain subscript stores under
+        # _state_lock. resume_seqs seeds each tenant's position (the
+        # per-tenant resume_seq: a restarted server passes checkpoint
+        # positions so acked chunks are never re-folded).
+        self._tseq: dict[int, list] = {
+            int(tid): [int(p), int(p), int(p)]
+            for tid, p in (resume_seqs or {}).items()
+        }
+        # Tenants held by policy (QoS park → wire PAUSE) and tenants
+        # shed (stream closed; frames answered with a typed NACK).
+        self._tenant_held: set[int] = set()
+        self._tenant_shed: dict[int, str] = {}
+        # Whether gauge-driven backpressure currently holds the wire —
+        # WELCOME carries it so a reconnecting client holds at once.
+        self._bp_paused = False
         # Optional zero-arg callable whose dict merges into every STATS
         # reply (e.g. the tenant engine's per-tenant telemetry via
         # TenantRouter). Failures are contained and reported in-band.
@@ -224,7 +266,10 @@ class IngestServer:
         # ledger under the same lock; drop() is a no-op when telemetry
         # never stamped.
         with self._state_lock:
-            obs_bus.get_bus().watermarks.drop(self.watermark_stream)
+            wmk = obs_bus.get_bus().watermarks
+            wmk.drop(self.watermark_stream)
+            for tid in self._tseq:
+                wmk.drop(f"{self.watermark_stream}:t{tid}")
 
     close = stop
 
@@ -295,10 +340,31 @@ class IngestServer:
                 )
             yield payload_to_chunk(payload, capacity, vertex_capacity)
 
-    def ack(self, upto: int) -> None:
+    def ack(self, upto: int, tenant=None) -> None:
         """Mark every seq < ``upto`` durable (consumer checkpoint
         covering those chunks committed) and push an ACK to the client.
-        The ``auto_ack=False`` half of the exactly-once contract."""
+        The ``auto_ack=False`` half of the exactly-once contract. In
+        ``tenant_streams`` mode pass ``tenant=`` — the ACK is scoped to
+        that tenant's sequence space (a ``{"tenant": ...}`` envelope
+        rides the frame)."""
+        if tenant is not None or self.tenant_streams:
+            if tenant is None:
+                raise ValueError(
+                    "tenant_streams server: ack(upto, tenant=tid)"
+                )
+            tid = int(tenant)
+            with self._state_lock:
+                st = self._tseq.setdefault(tid, [0, 0, 0])
+                if upto <= st[2]:
+                    return
+                st[2] = upto
+                st[1] = max(st[1], upto)
+                sock = self._conn_sock
+            if sock is not None:
+                self._send(sock, wire.pack_frame(
+                    wire.ACK, upto, wire.pack_json({"tenant": tid})))
+                obs_bus.get_bus().inc("ingest.acks_sent")
+            return
         with self._state_lock:
             if upto <= self._durable:
                 return
@@ -308,6 +374,82 @@ class IngestServer:
         if sock is not None:
             self._send(sock, wire.pack_frame(wire.ACK, upto))
             obs_bus.get_bus().inc("ingest.acks_sent")
+
+    def seed_tenant_seq(self, tenant, pos: int) -> None:
+        """Seed one tenant's expected/acked/durable wire position (the
+        per-tenant ``resume_seq``: the router passes each tenant's
+        engine position at attach so acked chunks are never re-folded).
+        Max-merges — never rewinds state a live connection advanced."""
+        tid = int(tenant)
+        pos = int(pos)
+        with self._state_lock:
+            st = self._tseq.setdefault(tid, [0, 0, 0])
+            st[0] = max(st[0], pos)
+            st[1] = max(st[1], pos)
+            st[2] = max(st[2], pos)
+
+    def wire_ledger(self, tenant=None) -> str:
+        """Watermark ledger key ingress stamps land under: the base
+        stream key, or the per-tenant sub-key in tenant_streams mode
+        (distinct per-tenant seq spaces must not collide on one
+        ledger)."""
+        if tenant is None or not self.tenant_streams:
+            return self.watermark_stream
+        return f"{self.watermark_stream}:t{int(tenant)}"
+
+    def pause_tenant(self, tenant) -> None:
+        """Policy hold (QoS park): PAUSE the tenant's stream. Scoped
+        with a ``{"tenant": ...}`` envelope in tenant_streams mode; a
+        legacy single-stream server pauses the whole wire (one tenant
+        per server there). The hold survives reconnects — WELCOME
+        carries it — and is lifted only by :meth:`resume_tenant`, never
+        by the backpressure loop's RESUME."""
+        tid = int(tenant)
+        with self._state_lock:
+            self._tenant_held.add(tid)
+            sock = self._conn_sock
+        if sock is not None:
+            if self.tenant_streams:
+                self._send(sock, wire.pack_frame(
+                    wire.PAUSE, 0, wire.pack_json({"tenant": tid})))
+            else:
+                self._send(sock, wire.pack_frame(wire.PAUSE, 0))
+
+    def resume_tenant(self, tenant) -> None:
+        """Lift a :meth:`pause_tenant` hold (QoS un-park) and RESUME
+        the stream (legacy mode: only once no other hold or
+        backpressure pause remains)."""
+        tid = int(tenant)
+        with self._state_lock:
+            self._tenant_held.discard(tid)
+            clear = not self._tenant_held and not self._bp_paused
+            sock = self._conn_sock
+        if sock is not None:
+            if self.tenant_streams:
+                self._send(sock, wire.pack_frame(
+                    wire.RESUME, 0, wire.pack_json({"tenant": tid})))
+            elif clear:
+                self._send(sock, wire.pack_frame(wire.RESUME, 0))
+
+    def shed_tenant(self, tenant, reason: str = "qos") -> None:
+        """Close a tenant's stream by policy: every subsequent frame
+        for it is refused with a typed NACK carrying the tenant's
+        durable position (everything below it is folded and safe;
+        nothing at/above it will ever be acked)."""
+        tid = int(tenant)
+        with self._state_lock:
+            self._tenant_held.discard(tid)
+            self._tenant_shed[tid] = str(reason)
+            st = self._tseq.setdefault(tid, [0, 0, 0])
+            durable = st[2]
+            sock = self._conn_sock
+        obs_bus.get_bus().inc("ingest.nacks_sent")
+        if sock is not None:
+            env = {"reason": str(reason)}
+            if self.tenant_streams:
+                env["tenant"] = tid
+            self._send(sock, wire.pack_frame(
+                wire.NACK, durable, wire.pack_json(env)))
 
     @property
     def next_seq(self) -> int:
@@ -378,6 +520,10 @@ class IngestServer:
                 bus.inc("ingest.acks_sent")
 
         recv = _timeout_recv(sock, self._stop, idle=flush_tail)
+        # Pre-shared-key handshake state (per connection): unauthed
+        # connections may only HELLO (challenge/proof) or BYE.
+        authed = self.auth_token is None
+        nonce: bytes | None = None
         try:
             while not self._stop.is_set():
                 try:
@@ -410,10 +556,25 @@ class IngestServer:
                     bus.inc("ingest.frames_rejected")
                     if tracer is not None:
                         tracer.instant("ingest.frame_rejected", seq=seq)
+                    if self.tenant_streams:
+                        # The tenant id lives in the (unverifiable)
+                        # payload, so no single stream's expect can be
+                        # named: ask the client to retransmit every
+                        # un-acked frame (duplicates drop + re-ack).
+                        self._send(sock, wire.pack_frame(
+                            wire.REJECT, 0,
+                            wire.pack_json({"resync": True})))
+                        continue
                     with self._state_lock:
                         expect = self._next_seq
                     self._send(sock, wire.pack_frame(wire.REJECT, expect))
                     continue
+                if not authed and ftype not in (wire.HELLO, wire.BYE):
+                    # Nothing but the handshake crosses an unauthed
+                    # connection — STATS introspection included.
+                    bus.inc("ingest.auth_failures")
+                    self._send(sock, wire.pack_frame(wire.AUTH_FAIL, 0))
+                    return
                 if ftype == wire.STATS:
                     # Read-only introspection, answerable mid-stream:
                     # touches neither the expected seq nor the ack
@@ -421,10 +582,40 @@ class IngestServer:
                     self._answer_stats(sock, bus, seq)
                     continue
                 if ftype == wire.HELLO:
+                    if not authed:
+                        proof = None
+                        if payload:
+                            try:
+                                proof = wire.unpack_json(payload).get(
+                                    "auth")
+                            except wire.FrameError:
+                                proof = None
+                        if proof is None:
+                            # First (bare) HELLO: challenge with a
+                            # fresh nonce; the client re-HELLOs with
+                            # the HMAC proof.
+                            nonce = secrets.token_bytes(16)
+                            bus.inc("ingest.auth_challenges")
+                            self._send(sock, wire.pack_frame(
+                                wire.AUTH_CHALLENGE, 0, nonce))
+                            continue
+                        want = hmac.new(
+                            self.auth_token.encode(), nonce or b"",
+                            "sha256",
+                        ).hexdigest()
+                        if not (isinstance(proof, str)
+                                and hmac.compare_digest(proof, want)):
+                            bus.inc("ingest.auth_failures")
+                            logger.warning(
+                                "auth failure from %s", addr)
+                            self._send(sock, wire.pack_frame(
+                                wire.AUTH_FAIL, 0))
+                            return
+                        authed = True
                     self._adopt(sock)
-                    with self._state_lock:
-                        expect = self._next_seq
-                    self._send(sock, wire.pack_frame(wire.WELCOME, expect))
+                    expect, wpayload = self._welcome_args()
+                    self._send(sock, wire.pack_frame(
+                        wire.WELCOME, expect, wpayload))
                     continue
                 if ftype == wire.BYE:
                     with self._state_lock:
@@ -441,6 +632,12 @@ class IngestServer:
                     continue  # unexpected control frame: ignore
                 self._adopt(sock)
                 compressed = ftype == wire.DATA_COMPRESSED
+                if self.tenant_streams:
+                    if not self._tenant_data(sock, bus, tracer, seq,
+                                             payload, compressed,
+                                             telemetry, t_rx):
+                        return  # stopped while staging
+                    continue
                 with self._state_lock:
                     expect = self._next_seq
                 if seq < expect:
@@ -511,6 +708,117 @@ class IngestServer:
                 if self._conn_sock is sock:
                     self._conn_sock = None
 
+    def _welcome_args(self) -> tuple[int, bytes]:
+        """WELCOME's (seq, payload): the legacy expected seq plus a
+        JSON body carrying pause/park/shed state — a reconnecting
+        client must hold a held stream IMMEDIATELY, not at the next
+        backpressure poll — and (tenant_streams) the whole per-tenant
+        expected-seq map."""
+        with self._state_lock:
+            if self.tenant_streams:
+                body = {
+                    "paused": self._bp_paused,
+                    "paused_tenants": sorted(self._tenant_held),
+                    "shed_tenants": sorted(self._tenant_shed),
+                    "streams": {str(tid): st[0]
+                                for tid, st in self._tseq.items()},
+                }
+            else:
+                # Legacy single-stream: a policy hold (one tenant per
+                # server) or an in-force backpressure pause holds the
+                # whole wire from the first frame after reconnect.
+                body = {
+                    "paused": self._bp_paused or bool(self._tenant_held),
+                }
+            return self._next_seq, wire.pack_json(body)
+
+    def _tenant_data(self, sock, bus, tracer, seq: int, payload: bytes,
+                     compressed: bool, telemetry: bool,
+                     t_rx: float) -> bool:
+        """One DATA frame in tenant_streams mode: the payload's
+        ``"tenant"`` entry selects the sequence space; duplicate/gap/
+        shed handling and acks are all scoped to it. Returns False only
+        when staging stopped (the conn loop exits). Reached only after
+        the conn loop's CRC guard — the payload bytes are verified."""
+        try:
+            data = wire.unpack_payload(payload)
+        except wire.FrameError as e:
+            bus.inc("ingest.frames_rejected")
+            logger.warning("malformed payload seq=%d: %s", seq, e)
+            self._send(sock, wire.pack_frame(
+                wire.REJECT, 0, wire.pack_json({"resync": True})))
+            return True
+        wt = data.get("tenant")
+        if wt is None:
+            bus.inc("ingest.chunks_unroutable")
+            logger.warning(
+                "tenant-streams frame seq=%d without a tenant id "
+                "dropped", seq,
+            )
+            return True
+        tid = int(np.asarray(wt).reshape(-1)[0])
+        with self._state_lock:
+            st = self._tseq.setdefault(tid, [0, 0, 0])
+            expect = st[0]
+            acked = st[1]
+            durable = st[2]
+            shed = self._tenant_shed.get(tid)
+        env = wire.pack_json({"tenant": tid})
+        if shed is not None:
+            # Terminal: the stream was closed by policy. The NACK's
+            # seq is the durable position — everything below it is
+            # folded and safe, nothing at/above it will ever be acked.
+            bus.inc("ingest.frames_shed")
+            bus.inc("ingest.nacks_sent")
+            self._send(sock, wire.pack_frame(
+                wire.NACK, durable,
+                wire.pack_json({"tenant": tid, "reason": shed})))
+            return True
+        if seq < expect:
+            # Reconnect replay of an already-staged chunk.
+            bus.inc("ingest.frames_duplicate")
+            self._send(sock, wire.pack_frame(wire.ACK, acked, env))
+            return True
+        if seq > expect:
+            bus.inc("ingest.frames_rejected")
+            self._send(sock, wire.pack_frame(wire.REJECT, expect, env))
+            return True
+        if telemetry:
+            # Ingress stamp BEFORE the admission wait (the e2e
+            # watermark counts backpressure time), under the state
+            # lock against a concurrent attach rekey — same contract
+            # as the legacy path's stamp site.
+            with self._state_lock:
+                bus.watermarks.stamp(self.wire_ledger(tid), seq)
+        self._apply_backpressure(sock, bus)
+        if not self._enqueue((seq, data, compressed)):
+            return False
+        with self._state_lock:
+            st = self._tseq[tid]
+            st[0] = seq + 1
+            if self.auto_ack:
+                st[1] = seq + 1
+            acked = st[1]
+        bus.inc("ingest.chunks_enqueued")
+        if telemetry:
+            bus.observe("ingest.receive_to_stage_ms",
+                        (time.perf_counter() - t_rx) * 1e3)
+        if compressed:
+            bus.inc("ingest.data_frames_compressed")
+        else:
+            bus.inc("ingest.data_frames_raw")
+        bus.gauge("ingest.staged_depth", self._q.qsize())
+        if tracer is not None:
+            tracer.instant("ingest.chunk_staged", track="ingest",
+                           seq=seq, tenant=tid, bytes=len(payload))
+        if self.auto_ack:
+            # Per-tenant acks are unbatched (ack_every applies to the
+            # legacy single-stream path): each tenant's flush() waits
+            # on its OWN space, so a remainder could strand it.
+            self._send(sock, wire.pack_frame(wire.ACK, acked, env))
+            bus.inc("ingest.acks_sent")
+        return True
+
     def _answer_stats(self, sock, bus, seq: int = 0) -> None:
         """Reply to one STATS frame: a JSON snapshot of the current bus
         (counters/gauges/histogram quantiles/watermarks/host identity)
@@ -540,7 +848,18 @@ class IngestServer:
                 "durable": self._durable,
                 "staged_depth": self._q.qsize(),
                 "auto_ack": self.auto_ack,
+                "tenant_streams": self.tenant_streams,
             }
+            if self.tenant_streams:
+                extra["server"]["tenants"] = {
+                    str(tid): {"next_seq": st[0], "acked": st[1],
+                               "durable": st[2]}
+                    for tid, st in self._tseq.items()
+                }
+                extra["server"]["held_tenants"] = sorted(
+                    self._tenant_held)
+                extra["server"]["shed_tenants"] = sorted(
+                    self._tenant_shed)
         try:
             body = json.dumps(build_stats(bus, extra=extra),
                               default=str).encode("utf-8")
@@ -574,6 +893,8 @@ class IngestServer:
         bus.emit("ingest.backpressure_engaged", depth=depth,
                  high_water=self.high_water)
         bus.gauge("ingest.paused", 1)
+        with self._state_lock:
+            self._bp_paused = True
         self._send(sock, wire.pack_frame(wire.PAUSE, 0))
         try:
             while not self._stop.is_set():
@@ -584,7 +905,16 @@ class IngestServer:
                 time.sleep(self.pause_poll_s)
         finally:
             bus.gauge("ingest.paused", 0)
-            self._send(sock, wire.pack_frame(wire.RESUME, 0))
+            with self._state_lock:
+                self._bp_paused = False
+                # A legacy-mode POLICY hold (pause_tenant on a single-
+                # stream server) must survive a backpressure release:
+                # the bare RESUME below would lift it. Tenant-scoped
+                # holds ride their own envelopes, so tenant_streams
+                # always RESUMEs the wire-level pause.
+                resume = self.tenant_streams or not self._tenant_held
+            if resume:
+                self._send(sock, wire.pack_frame(wire.RESUME, 0))
 
 
 class TenantRouter:
@@ -608,14 +938,21 @@ class TenantRouter:
     engine backlog, not just its own socket buffer.
 
     Delivery semantics are the attached servers' ``auto_ack`` contract
-    (the router acks nothing itself); per-tenant wire sequence spaces
-    remain the caller's to resume (``MultiTenantEngine.position`` is
-    the per-tenant replay point).
+    by default. With ``checkpoint_acks=True`` (servers constructed
+    with ``auto_ack=False``), the router registers on the engine's
+    ``on_durable`` hooks and fires ``server.ack(pos, tenant=tid)``
+    after each tenant's CheckpointManager rotation — checkpoint-gated
+    per-tenant acks, the multi-tenant exactly-once wire. Attaching a
+    ``tenant_streams=True`` server also seeds each admitted tenant's
+    wire position from ``MultiTenantEngine.position`` (the per-tenant
+    replay point), and the engine's ``on_qos`` transitions are mapped
+    onto wire control (park → PAUSE, un-park → RESUME, shed → NACK).
     """
 
     def __init__(self, engine, tier: str, *,
                  vertex_capacity: int | None = None,
-                 tenant_of=None, auto_admit: bool = True):
+                 tenant_of=None, auto_admit: bool = True,
+                 checkpoint_acks: bool = False):
         self.engine = engine
         self.tier = tier
         self.vertex_capacity = vertex_capacity
@@ -630,6 +967,25 @@ class TenantRouter:
         self._stop = threading.Event()
         self._admit_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
+        # tenant id -> the server its stream rides (checkpoint-gated
+        # acks and QoS wire actions are addressed through it).
+        self._tenant_server: dict = {}
+        self.checkpoint_acks = bool(checkpoint_acks)
+        if checkpoint_acks:
+            hooks = getattr(engine, "on_durable", None)
+            if hooks is None:
+                raise ValueError(
+                    "checkpoint_acks=True needs an engine exposing "
+                    "on_durable hooks (MultiTenantEngine); attach the "
+                    "servers with auto_ack=False so acks are gated on "
+                    "the per-tenant checkpoint rotation"
+                )
+            hooks.append(self._on_durable)
+        qos_hooks = getattr(engine, "on_qos", None)
+        if qos_hooks is not None:
+            # QoS ladder transitions map onto wire control: park →
+            # PAUSE, un-park → RESUME, shed → typed NACK.
+            qos_hooks.append(self._on_qos)
 
     def attach(self, server: IngestServer,
                default_tenant=None) -> threading.Thread:
@@ -658,8 +1014,30 @@ class TenantRouter:
         with server._state_lock:
             old_key = server.watermark_stream
             server.watermark_stream = f"wire:{server.port}"
-            obs_bus.get_bus().watermarks.rekey(old_key,
-                                               server.watermark_stream)
+            wmk = obs_bus.get_bus().watermarks
+            wmk.rekey(old_key, server.watermark_stream)
+            for tid in server._tseq:
+                # Per-tenant sub-ledgers move with the base key.
+                wmk.rekey(f"{old_key}:t{tid}",
+                          f"{server.watermark_stream}:t{tid}")
+        if default_tenant is not None:
+            with self._admit_lock:
+                self._tenant_server[default_tenant] = server
+        if getattr(server, "tenant_streams", False):
+            # Seed each admitted tenant's wire position from the
+            # engine's resume point, so a restarted server re-welcomes
+            # every tenant at its durable position (nothing acked is
+            # ever re-folded, nothing unacked is skipped).
+            tenant_ids = getattr(self.engine, "tenant_ids", None)
+            if tenant_ids is not None:
+                for tid in tenant_ids():
+                    try:
+                        server.seed_tenant_seq(
+                            tid, self.engine.position(tid))
+                    except KeyError:
+                        continue
+                    with self._admit_lock:
+                        self._tenant_server[tid] = server
         t = threading.Thread(
             target=self._drain_loop, args=(server, default_tenant),
             daemon=True, name="gelly-tenant-router",
@@ -681,6 +1059,42 @@ class TenantRouter:
     def __exit__(self, *exc):
         self.stop()
 
+    def _on_durable(self, tid, position) -> None:
+        """Checkpoint-gated wire ack: the engine fires this AFTER the
+        tenant's CheckpointManager rotation made ``position`` durable
+        (the ``manager.save`` in ``_checkpoint_tier`` /
+        ``_execute_parks`` dominates every call), so the ack below can
+        never precede its durability point — the multi-tenant half of
+        the auto_ack=False exactly-once contract."""
+        srv = self._tenant_server.get(tid)
+        if srv is None:
+            return
+        try:
+            if srv.tenant_streams:
+                srv.ack(position, tenant=tid)  # graphlint: disable=EO001 -- durability dominates across the hook boundary: the engine fires on_durable only after manager.save committed this position
+            else:
+                srv.ack(position)  # graphlint: disable=EO001 -- durability dominates across the hook boundary: the engine fires on_durable only after manager.save committed this position
+        except Exception:  # noqa: BLE001 — acks must never kill the engine
+            logger.exception(
+                "checkpoint-gated ack failed for tenant %r", tid)
+
+    def _on_qos(self, tid, action: str, info: dict) -> None:
+        """Map QoS ladder transitions onto wire control frames."""
+        srv = self._tenant_server.get(tid)
+        if srv is None:
+            return
+        try:
+            if action == "park":
+                srv.pause_tenant(tid)
+            elif action == "unpark":
+                srv.resume_tenant(tid)
+            elif action == "shed":
+                srv.shed_tenant(tid,
+                                reason=str(info.get("reason", "qos")))
+        except Exception:  # noqa: BLE001 — wire control must never kill the engine
+            logger.exception(
+                "qos wire action %r failed for tenant %r", action, tid)
+
     def _ensure_admitted(self, tid) -> bool:
         with self._admit_lock:
             try:
@@ -690,8 +1104,17 @@ class TenantRouter:
                 pass
             if not self.auto_admit:
                 return False
-            self.engine.admit(tid, self.tier)
-            return True
+            try:
+                lane = self.engine.admit(tid, self.tier)
+            except Exception as e:  # noqa: BLE001
+                # AdmissionRefused (QoS ceiling) or an already-queued
+                # duplicate: drop the chunk observably, keep draining.
+                logger.warning("tenant %r not admitted: %s", tid, e)
+                return False
+            # lane == -1: queued admission (QoS admission="queue") —
+            # the engine admits it once pressure drains; until then
+            # its chunks are unroutable.
+            return lane >= 0
 
     def _drain_loop(self, server: IngestServer, default_tenant) -> None:
         bus = obs_bus.get_bus()
@@ -716,6 +1139,8 @@ class TenantRouter:
                         "default); dropped", wire_tenant,
                     )
                     continue
+                with self._admit_lock:
+                    self._tenant_server[tid] = server
                 if compressed:
                     # Client-side-compressed payload straight into the
                     # compressed tier's queue: no payload_to_chunk, no
@@ -746,9 +1171,11 @@ class TenantRouter:
                 # Routed into a per-tenant queue: the per-tenant ledger
                 # (stamped by engine.submit*) owns the e2e watermark
                 # from here; drain this server's wire ledger so it
-                # never reads as backlog nobody will retire.
-                bus.watermarks.retire_durable(server.watermark_stream,
-                                              seq + 1)
+                # never reads as backlog nobody will retire. Tenant-
+                # streams servers stamp under per-tenant sub-keys (the
+                # seq is scoped to the tenant), so retire matches.
+                bus.watermarks.retire_durable(
+                    server.wire_ledger(tid), seq + 1)
 
 
 class _ConnClosed(Exception):

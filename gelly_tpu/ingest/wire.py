@@ -12,7 +12,7 @@ Frame layout (network byte order)::
 
     magic  u16   0x4749 ("GI")
     type   u8    HELLO/WELCOME/DATA/ACK/REJECT/PAUSE/RESUME/BYE/
-                 DATA_COMPRESSED/STATS
+                 DATA_COMPRESSED/STATS/NACK/AUTH_CHALLENGE/AUTH_FAIL
     flags  u8    reserved (0)
     seq    u64   per-stream sequence number (DATA/DATA_COMPRESSED: the
                  chunk position; ACK/REJECT/WELCOME: the position being
@@ -31,6 +31,7 @@ resume makes the tear harmless.
 
 from __future__ import annotations
 
+import json
 import struct
 import zlib
 
@@ -65,9 +66,27 @@ DATA_COMPRESSED = 9
 # expected sequence nor the ack state — on a dedicated connection the
 # server does not even adopt it as the data connection.
 STATS = 10
+# Typed stream refusal (server -> client): the tenant's stream was
+# CLOSED by policy (QoS shed). seq carries the tenant's durable
+# position — everything below it is folded and safe; everything at or
+# above it was dropped and will NOT be acked. Payload is control JSON
+# ``{"tenant": ..., "reason": ...}``. Unlike REJECT (a per-frame
+# refusal that invites a rewind-and-resend), NACK is terminal for the
+# stream: the client must stop sending for that tenant and surface the
+# refusal to its producer.
+NACK = 11
+# Pre-shared-key handshake (server -> client): the server demands an
+# HMAC proof before adopting the connection. Payload = an opaque nonce;
+# the client re-HELLOs with ``{"auth": hex(HMAC-SHA256(token, nonce))}``
+# in its payload. Sent only by servers constructed with auth_token=.
+AUTH_CHALLENGE = 12
+# Authentication failed (server -> client): missing/bad proof, or a
+# non-handshake frame before authentication. Terminal — the server
+# closes the connection after sending it.
+AUTH_FAIL = 13
 
 FRAME_TYPES = (HELLO, WELCOME, DATA, ACK, REJECT, PAUSE, RESUME, BYE,
-               DATA_COMPRESSED, STATS)
+               DATA_COMPRESSED, STATS, NACK, AUTH_CHALLENGE, AUTH_FAIL)
 
 # Bound on a single payload (64 MiB): a length prefix beyond it is
 # treated as a corrupt header, not an allocation request.
@@ -230,3 +249,29 @@ def unpack_payload(buf: bytes) -> dict:
             f"{len(view) - pos} trailing bytes after the last array"
         )
     return out
+
+
+def pack_json(obj: dict) -> bytes:
+    """Serialize a control-frame JSON payload (WELCOME's per-tenant
+    state, tenant-scoped ACK/PAUSE/RESUME/NACK envelopes, HELLO auth
+    proofs). Sorted keys + compact separators: equal dicts produce
+    identical bytes, hence identical CRCs — the same determinism
+    discipline as :func:`pack_payload`."""
+    return json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def unpack_json(buf: bytes) -> dict:
+    """Inverse of :func:`pack_json`; :class:`FrameError` on malformed
+    or non-object JSON (the CRC already vouched for the bytes — this
+    guards against a malformed sender)."""
+    try:
+        obj = json.loads(bytes(buf).decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise FrameError(f"bad control JSON payload: {e}") from e
+    if not isinstance(obj, dict):
+        raise FrameError(
+            f"control JSON payload must be an object, got "
+            f"{type(obj).__name__}"
+        )
+    return obj

@@ -63,6 +63,18 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``ingest.stats_requests``             STATS introspection frames
                                       answered (read-only; never
                                       advances DATA sequencing)
+``ingest.auth_challenges``            AUTH_CHALLENGE nonces issued to
+                                      unauthenticated HELLOs
+``ingest.auth_failures``              connections refused by the
+                                      pre-shared-key gate (bad/missing
+                                      proof, or data before auth)
+``ingest.nacks_sent``                 terminal NACK frames sent (QoS
+                                      shed streams; seq = durable pos)
+``ingest.nacks_received``             NACK frames seen by the client
+                                      (its stream was shed server-side)
+``ingest.frames_shed``                DATA frames dropped on arrival
+                                      because their tenant's stream is
+                                      shed (never staged, never acked)
 ``engine.units_folded``               pipeline units retired by a fold
 ``engine.chunks_folded``              chunks inside those units
 ``engine.edges_folded``               valid edges (tracer-enabled runs)
@@ -96,6 +108,31 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
                                       (tier lane stack halved)
 ``tenants.lanes_reclaimed``           lanes freed by idle-lane
                                       reclamation, cumulative
+``qos.rate_limited``                  ladder OK→LIMITED transitions
+                                      (tenant over its backlog budget)
+``qos.limit_cleared``                 LIMITED→OK recoveries (backlog
+                                      back under budget)
+``qos.parked``                        LIMITED→PARKED transitions (lane
+                                      freed at the next safe window
+                                      boundary; snapshots stay live)
+``qos.unparked``                      PARKED→LIMITED re-admissions
+                                      (active pressure drained below
+                                      the un-park threshold)
+``qos.shed``                          PARKED→SHED terminations (parked
+                                      queue exceeded shed_queue_depth;
+                                      typed NACK on the wire)
+``qos.chunks_dropped``                queued chunks discarded by shed
+                                      transitions, cumulative
+``qos.admissions_refused``            admit() calls refused at the
+                                      backlog-age ceiling
+                                      (admission="refuse")
+``qos.admissions_queued``             admit() calls parked in the
+                                      waiting line (admission="queue")
+``qos.admissions_resumed``            queued admissions completed once
+                                      pressure fell under the ceiling
+``qos.limited_tenants``               tenants at LIMITED (gauge)
+``qos.parked_tenants``                tenants at PARKED (gauge)
+``qos.shed_tenants``                  tenants at SHED (gauge)
 ``multiquery.runs``                   fused multi-query runs started
 ``multiquery.fused_queries``          queries riding the active fused
                                       plan (gauge)

@@ -1,0 +1,97 @@
+"""Subprocess body for the multi-tenant WIRE kill -9 test
+(test_tenant_wire.py) — the exactly-once contract across the full
+stack: one ``tenant_streams`` server, N per-tenant sequence spaces,
+checkpoint-gated per-tenant acks.
+
+Runs a :class:`MultiTenantEngine` (per-tenant checkpoints, resume=True)
+behind a :class:`TenantRouter` with ``checkpoint_acks=True`` and an
+``auto_ack=False`` ``tenant_streams`` :class:`IngestServer`: a tenant's
+wire ACK fires only after its own CheckpointManager rotation made the
+position durable. A SIGKILL at ANY point can therefore never
+double-fold an acked chunk — the restarted incarnation re-admits every
+tenant at its newest valid checkpoint, the re-attach seeds the
+per-tenant wire positions from those resume points, and the
+reconnecting client replays exactly each tenant's unacked suffix. The
+tier folds DEGREES (pure counting — non-idempotent), so the parent's
+bit-identity assertion is sharp: one duplicated or dropped chunk
+doubles or loses counts.
+
+argv: <ckpt_dir> <port_file> <out_npz> <total_chunks_per_tenant>
+Env: GELLY_QOS_TENANTS / _NV / _CHUNK override the shape.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+TENANTS = int(os.environ.get("GELLY_QOS_TENANTS", "3"))
+N_V = int(os.environ.get("GELLY_QOS_NV", "96"))
+CHUNK = int(os.environ.get("GELLY_QOS_CHUNK", "16"))
+
+
+def main(argv):
+    ckpt_dir, port_file, out_path = argv[0], argv[1], argv[2]
+    total = int(argv[3])
+
+    from gelly_tpu.engine.checkpoint import save_checkpoint
+    from gelly_tpu.engine.tenants import MultiTenantEngine
+    from gelly_tpu.ingest import IngestServer, TenantRouter
+    from gelly_tpu.library.degrees import degree_aggregate
+
+    eng = MultiTenantEngine(
+        merge_every=2, checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        resume=True,
+    )
+    eng.add_tier("deg", degree_aggregate(N_V, ingest_combine=False),
+                 CHUNK)
+    # Pre-admit BEFORE attach: resume=True reloads each tenant's newest
+    # checkpoint here, and attach() then seeds the per-tenant wire
+    # positions from those resume points — the restarted server
+    # re-welcomes every tenant at its durable position.
+    for tid in range(TENANTS):
+        eng.admit(tid, "deg")
+    srv = IngestServer(auto_ack=False, tenant_streams=True,
+                       queue_depth=16).start()
+    router = TenantRouter(eng, "deg", vertex_capacity=N_V,
+                          checkpoint_acks=True)
+    eng.start()
+    router.attach(srv)
+    # Publish the port only once the router is attached (frames staged
+    # before attach would ride the default watermark key).
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(srv.port))
+    os.replace(tmp, port_file)
+
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if all(eng.position(t) >= total for t in range(TENANTS)):
+                break
+            time.sleep(0.02)
+        for tid in range(TENANTS):
+            eng.finish(tid)
+        while time.time() < deadline:
+            tel = eng.telemetry()
+            if all(tel[str(t)]["done"] for t in range(TENANTS)):
+                break
+            time.sleep(0.02)
+        # One idle scheduler round flushes the final partial windows
+        # (and fires their checkpoint-gated acks).
+        time.sleep(0.5)
+        rows = [np.asarray(eng.degree(t)) for t in range(TENANTS)]
+        positions = [eng.position(t) for t in range(TENANTS)]
+    finally:
+        srv.stop()
+        router.stop()
+        eng.stop()
+    save_checkpoint(out_path, rows, position=sum(positions))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,521 @@
+"""QoS policy plane (``gelly_tpu/engine/qos.py``) + engine wiring.
+
+Controller-level tests drive :class:`QosController` directly with an
+injectable clock (deterministic DRR / token-bucket / ladder math);
+engine-level tests stub the watermark backlog signal and prove the
+full degradation ladder — limit, park (lane freed, snapshots still
+answering), un-park, shed — plus admission control, with results
+staying bit-identical to the single-stream oracle throughout.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from gelly_tpu import edge_stream_from_edges
+from gelly_tpu.engine.qos import (
+    QOS_LIMITED,
+    QOS_OK,
+    QOS_PARKED,
+    QOS_SHED,
+    AdmissionRefused,
+    QosController,
+    QosPolicy,
+)
+from gelly_tpu.engine.tenants import MultiTenantEngine
+from gelly_tpu.library.connected_components import connected_components
+from gelly_tpu.obs import bus as obs_bus
+
+pytestmark = pytest.mark.tenants
+
+N_V = 128
+CHUNK = 32
+
+
+def _edges(seed: int, n_edges: int = 96, n_v: int = N_V):
+    rng = np.random.default_rng(seed)
+    pairs = rng.integers(0, n_v, (n_edges, 2))
+    return [(int(a), int(b)) for a, b in pairs]
+
+
+def _stream(seed: int, n_edges: int = 96, n_v: int = N_V,
+            chunk: int = CHUNK):
+    return edge_stream_from_edges(
+        _edges(seed, n_edges, n_v), vertex_capacity=n_v, chunk_size=chunk,
+    )
+
+
+def _cc_plan(n_v: int = N_V):
+    return connected_components(n_v, merge="gather", ingest_combine=False)
+
+
+def _wait(pred, timeout=20.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+# --------------------------------------------------------------------- #
+# policy validation
+
+
+def test_policy_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="weight"):
+        QosPolicy(weight=0)
+    with pytest.raises(ValueError, match="rate_limit_cps"):
+        QosPolicy(rate_limit_cps=-5)
+    with pytest.raises(ValueError, match="backlog_budget_s"):
+        QosPolicy(backlog_budget_s=0)
+    with pytest.raises(ValueError, match="limit_after"):
+        QosPolicy(limit_after=0)
+    with pytest.raises(ValueError, match="limited_weight_factor"):
+        QosPolicy(limited_weight_factor=0)
+    with pytest.raises(ValueError, match="limited_weight_factor"):
+        QosPolicy(limited_weight_factor=1.5)
+    with pytest.raises(ValueError, match="shed_queue_depth"):
+        QosPolicy(shed_queue_depth=0)
+    with pytest.raises(ValueError, match="burst"):
+        QosPolicy(burst=0.5)
+    with pytest.raises(ValueError, match="unpark_grace_s"):
+        QosPolicy(unpark_grace_s=-1)
+
+
+def test_unpark_threshold_defaults_to_half_budget():
+    assert QosPolicy(backlog_budget_s=2.0).unpark_threshold() == 1.0
+    assert QosPolicy(backlog_budget_s=2.0,
+                     unpark_below_s=0.3).unpark_threshold() == 0.3
+    assert QosPolicy().unpark_threshold() is None
+
+
+def test_controller_validation():
+    with pytest.raises(ValueError, match="admission"):
+        QosController(admission="drop")
+    with pytest.raises(ValueError, match="admission_ceiling_s"):
+        QosController(admission_ceiling_s=0)
+    qos = QosController()
+    with pytest.raises(TypeError, match="QosPolicy"):
+        qos.set_policy("t", {"weight": 2})
+    qos.set_policy("t", QosPolicy(weight=2))
+    assert qos.policy_for("t").weight == 2
+    assert qos.policy_for("unknown") is qos.default
+    assert qos.state("never-seen") == QOS_OK
+
+
+# --------------------------------------------------------------------- #
+# deficit-round-robin fairness
+
+
+def test_drr_grants_follow_weights_exactly():
+    """Weights 1:2:4 over R rounds → grants R/4 : R/2 : R (the heaviest
+    tenant dispatches every round; the fairness bound floor(R*w/wmax)-1
+    holds for everyone)."""
+    clk = [0.0]
+    qos = QosController(
+        per_tenant={
+            "a": QosPolicy(weight=1),
+            "b": QosPolicy(weight=2),
+            "c": QosPolicy(weight=4),
+        },
+        clock=lambda: clk[0],
+    )
+    R = 400
+    grants = {"a": 0, "b": 0, "c": 0}
+    for _ in range(R):
+        clk[0] += 0.01
+        for tid in qos.plan_round(["a", "b", "c"]):
+            grants[tid] += 1
+    assert grants["c"] == R
+    for tid, w in (("a", 1), ("b", 2), ("c", 4)):
+        assert grants[tid] >= (R * w) // 4 - 1
+        assert grants[tid] <= (R * w) // 4 + 1
+
+
+def test_drr_credit_carries_but_never_banks_unbounded():
+    """A light tenant's credit carries across rounds (no starvation
+    below its share) but is capped at one round's surplus — an idle
+    spell cannot bank a burst."""
+    clk = [0.0]
+    qos = QosController(
+        per_tenant={"lo": QosPolicy(weight=1), "hi": QosPolicy(weight=4)},
+        clock=lambda: clk[0],
+    )
+    # 40 rounds with both backlogged: lo granted every 4th round.
+    lo = 0
+    for _ in range(40):
+        clk[0] += 0.01
+        lo += "lo" in qos.plan_round(["lo", "hi"])
+    assert lo == 10
+    # 100 rounds where lo is NOT backlogged (absent from the round):
+    # its credit must not accumulate meanwhile.
+    for _ in range(100):
+        clk[0] += 0.01
+        qos.plan_round(["hi"])
+    burst = sum(
+        "lo" in qos.plan_round(["lo", "hi"]) for _ in range(8)
+    )
+    assert burst <= 3  # ~2 grants in 8 rounds at weight 1/4, plus cap slack
+
+
+def test_token_bucket_caps_rate():
+    """rate_limit_cps bounds grants to rate * elapsed + burst even when
+    DRR credit would allow a grant every round."""
+    clk = [0.0]
+    qos = QosController(
+        per_tenant={"t": QosPolicy(rate_limit_cps=10, burst=2)},
+        clock=lambda: clk[0],
+    )
+    granted = 0
+    for _ in range(100):  # 1 simulated second
+        clk[0] += 0.01
+        granted += len(qos.plan_round(["t"]))
+    assert 10 <= granted <= 13  # 10 cps + 2-token burst (+1 slack)
+
+
+def test_parked_and_shed_tenants_never_granted():
+    clk = [0.0]
+    pol = QosPolicy(backlog_budget_s=1.0, limit_after=1, park_after=1,
+                    shed_queue_depth=2)
+    qos = QosController(default=pol, clock=lambda: clk[0])
+    ev = lambda **kw: qos.evaluate("t", **kw)  # noqa: E731
+    assert ev(backlog_age_s=5, queue_depth=0,
+              active_backlog_max_s=5) == "limit"
+    assert ev(backlog_age_s=5, queue_depth=0,
+              active_backlog_max_s=5) == "park"
+    assert qos.plan_round(["t"]) == set()
+    assert ev(backlog_age_s=5, queue_depth=3,
+              active_backlog_max_s=5) == "shed"
+    assert qos.plan_round(["t"]) == set()
+    assert qos.state("t") == QOS_SHED
+    # Shed is terminal: further evaluations are inert.
+    assert ev(backlog_age_s=0, queue_depth=0,
+              active_backlog_max_s=0) is None
+
+
+# --------------------------------------------------------------------- #
+# the degradation ladder
+
+
+def test_ladder_limit_park_unpark_grace_and_clear():
+    clk = [0.0]
+    pol = QosPolicy(backlog_budget_s=1.0, limit_after=2, park_after=2,
+                    unpark_below_s=0.5, unpark_grace_s=5.0)
+    qos = QosController(default=pol, clock=lambda: clk[0])
+
+    def ev(age, depth=0, amax=None):
+        return qos.evaluate(
+            "t", backlog_age_s=age, queue_depth=depth,
+            active_backlog_max_s=age if amax is None else amax,
+        )
+
+    # OK -> LIMITED after limit_after consecutive over-budget evals.
+    assert ev(2.0) is None
+    assert ev(2.0) == "limit"
+    assert qos.state("t") == QOS_LIMITED
+    # A below-budget eval resets the streak (but 0.7 >= unpark_below_s,
+    # so the limit does not clear yet).
+    assert ev(0.7) is None
+    assert qos.state("t") == QOS_LIMITED
+    # LIMITED -> PARKED after park_after more over-budget evals.
+    assert ev(2.0) is None
+    assert ev(2.0) == "park"
+    assert qos.state("t") == QOS_PARKED
+    # Parked holds while ACTIVE pressure stays above the threshold —
+    # the tenant's OWN (stale, aging) backlog is not the gate.
+    assert ev(9.0, amax=2.0) is None
+    # Un-park once active pressure drains; re-enter at LIMITED.
+    assert ev(9.0, amax=0.1) == "unpark"
+    assert qos.state("t") == QOS_LIMITED
+    # Grace holiday: own backlog still over budget, no escalation.
+    clk[0] += 1.0
+    assert ev(9.0) is None
+    assert ev(9.0) is None
+    assert qos.state("t") == QOS_LIMITED
+    # Holiday over: escalation resumes (park_after=2 evals to re-park).
+    clk[0] += 10.0
+    assert ev(9.0) is None
+    assert ev(9.0) == "park"
+    # Un-park again, then fully drain: LIMITED clears to OK.
+    assert ev(9.0, amax=0.0) == "unpark"
+    clk[0] += 10.0
+    assert ev(0.1) == "clear"
+    assert qos.state("t") == QOS_OK
+
+
+def test_ladder_never_engages_without_budget():
+    qos = QosController(default=QosPolicy())  # backlog_budget_s=None
+    for _ in range(10):
+        assert qos.evaluate("t", backlog_age_s=1e9, queue_depth=10,
+                            active_backlog_max_s=1e9) is None
+    assert qos.state("t") == QOS_OK
+
+
+def test_forget_drops_ladder_state():
+    pol = QosPolicy(backlog_budget_s=1.0, limit_after=1)
+    qos = QosController(default=pol)
+    assert qos.evaluate("t", backlog_age_s=5, queue_depth=0,
+                        active_backlog_max_s=5) == "limit"
+    assert qos.counts()[QOS_LIMITED] == 1
+    qos.forget("t")
+    assert qos.state("t") == QOS_OK
+    assert qos.counts()[QOS_LIMITED] == 0
+
+
+# --------------------------------------------------------------------- #
+# engine integration: weighted fair share
+
+
+def test_weighted_fair_share_paces_dispatch_rounds():
+    """heavy (w=4) and light (w=1), 8 chunks each: heavy folds in 8
+    rounds while light is paced to every 4th round, then light runs
+    solo at full quantum — 14 dispatch rounds total (vs 8 unpaced),
+    results bit-identical per tenant."""
+    cc = _cc_plan()
+    qos = QosController(per_tenant={
+        "heavy": QosPolicy(weight=4), "light": QosPolicy(weight=1),
+    })
+    with obs_bus.scope():
+        eng = MultiTenantEngine(merge_every=1, qos=qos)
+        eng.add_tier("cc", cc, CHUNK)
+        eng.admit("heavy", "cc", chunks=_stream(1, n_edges=256))
+        eng.admit("light", "cc", chunks=_stream(2, n_edges=256))
+        out = eng.drain()
+    assert eng.stats["chunks"] == 16
+    assert eng.stats["dispatches"] == 14
+    for tid, seed in (("heavy", 1), ("light", 2)):
+        want = np.asarray(
+            _stream(seed, n_edges=256).aggregate(cc, merge_every=1).result()
+        )
+        assert out[tid].tobytes() == want.tobytes()
+
+
+# --------------------------------------------------------------------- #
+# engine integration: admission control
+
+
+def test_admission_refused_over_ceiling():
+    cc = _cc_plan()
+    qos = QosController(admission_ceiling_s=1.0)
+    with obs_bus.scope() as bus:
+        eng = MultiTenantEngine(merge_every=1, qos=qos)
+        eng.add_tier("cc", cc, CHUNK)
+        eng._active_backlog_age = lambda: 7.5
+        with pytest.raises(AdmissionRefused) as ei:
+            eng.admit("t", "cc")
+        assert ei.value.tenant_id == "t"
+        assert ei.value.backlog_age_s == 7.5
+        assert ei.value.ceiling_s == 1.0
+        assert bus.counters["qos.admissions_refused"] == 1
+        assert "t" not in eng.tenant_ids()
+        # Pressure drains -> the same admit succeeds.
+        eng._active_backlog_age = lambda: 0.1
+        assert eng.admit("t", "cc") >= 0
+
+
+def test_admission_queue_resumes_when_pressure_drains():
+    cc = _cc_plan()
+    qos = QosController(admission_ceiling_s=1.0, admission="queue",
+                        eval_every_s=0.01)
+    with obs_bus.scope() as bus:
+        eng = MultiTenantEngine(merge_every=1, qos=qos, poll_s=0.01)
+        eng.add_tier("cc", cc, CHUNK)
+        eng._active_backlog_age = lambda: 7.5
+        assert eng.admit("t", "cc", chunks=_stream(3)) == -1
+        assert bus.counters["qos.admissions_queued"] == 1
+        with pytest.raises(ValueError, match="already admitted or queued"):
+            eng.admit("t", "cc")
+        assert "t" not in eng.tenant_ids()
+        eng.start()
+        try:
+            # Still over the ceiling: the waiter stays parked at the door.
+            time.sleep(0.2)
+            assert "t" not in eng.tenant_ids()
+            # Pressure drains -> the retry pass admits and the tenant
+            # runs to completion.
+            eng._active_backlog_age = lambda: 0.1
+            assert _wait(lambda: "t" in eng.tenant_ids())
+            assert _wait(lambda: bus.snapshot()["counters"].get(
+                "qos.admissions_resumed", 0) == 1)
+            assert _wait(lambda: eng.position("t") == 3)
+            want = np.asarray(
+                _stream(3).aggregate(cc, merge_every=1).result()
+            )
+            assert _wait(
+                lambda: eng.labels("t") is not None
+                and eng.labels("t").tobytes() == want.tobytes()
+            )
+        finally:
+            eng.stop()
+
+
+# --------------------------------------------------------------------- #
+# engine integration: park / un-park / shed end-to-end
+
+
+def _park_victim(bus, eng, ages, victim="victim", other="other"):
+    """Drive the stubbed backlog signal until `victim` is parked with
+    its lane freed; `other` keeps the active pressure high."""
+    ages[victim] = 10.0
+    ages[other] = 10.0
+    assert _wait(lambda: eng.qos_state(victim) == QOS_PARKED)
+    assert _wait(lambda: eng._tenants[victim].lane == -1)
+    assert bus.counters["qos.parked"] >= 1
+
+
+def test_park_frees_lane_unpark_restores_bit_identical():
+    cc = _cc_plan()
+    pol = QosPolicy(backlog_budget_s=0.5, limit_after=1, park_after=1,
+                    unpark_below_s=0.25, unpark_grace_s=0.0)
+    qos = QosController(default=QosPolicy(), eval_every_s=0.01,
+                        per_tenant={"victim": pol})
+    with obs_bus.scope() as bus:
+        ages = {}
+        bus.watermarks.backlog_age = lambda tid: ages.get(tid, 0.0)
+        eng = MultiTenantEngine(merge_every=1, qos=qos, poll_s=0.01)
+        eng.add_tier("cc", cc, CHUNK)
+        eng.admit("victim", "cc")
+        eng.admit("other", "cc")
+        vic = list(_stream(1, n_edges=256))  # 8 chunks
+        oth = list(_stream(2, n_edges=256))
+        eng.start()
+        try:
+            for ch in vic[:2]:
+                eng.submit("victim", ch)
+            for ch in oth[:2]:
+                eng.submit("other", ch)
+            assert _wait(lambda: eng.position("victim") == 2
+                         and eng.position("other") == 2)
+            _park_victim(bus, eng, ages)
+            assert bus.counters["qos.rate_limited"] >= 1
+            # Parked but queryable: the saved row still answers, at the
+            # park-time position.
+            assert eng.labels("victim") is not None
+            assert eng.telemetry()["victim"]["qos_state"] == QOS_PARKED
+            # Submitting to a parked tenant queues (no drop, no raise).
+            eng.submit("victim", vic[2])
+            assert eng.queue_depth("victim") == 1
+            # Active pressure drains -> auto un-park onto a lane.
+            ages["other"] = 0.0
+            ages["victim"] = 0.0
+            assert _wait(lambda: eng.qos_state("victim") != QOS_PARKED)
+            assert _wait(lambda: bus.snapshot()["counters"].get(
+                "qos.unparked", 0) == 1)
+            assert _wait(lambda: eng._tenants["victim"].lane >= 0)
+            # Feed the rest; both tenants finish bit-identical.
+            for ch in vic[3:]:
+                eng.submit("victim", ch)
+            for ch in oth[2:]:
+                eng.submit("other", ch)
+            eng.finish("victim")
+            eng.finish("other")
+            for tid, seed in (("victim", 1), ("other", 2)):
+                want = np.asarray(
+                    _stream(seed, n_edges=256)
+                    .aggregate(cc, merge_every=1).result()
+                )
+                assert _wait(
+                    lambda t=tid, w=want: eng.labels(t) is not None
+                    and eng.labels(t).tobytes() == w.tobytes()
+                ), tid
+        finally:
+            eng.stop()
+
+
+def test_overload_sheds_parked_tenant_and_bounds_backlog():
+    """The overload contract: a parked tenant whose queue keeps growing
+    past shed_queue_depth is shed — its queue is DROPPED (backlog stays
+    bounded), its stream closes, and the surviving tenant completes
+    bit-identically."""
+    cc = _cc_plan()
+    pol = QosPolicy(backlog_budget_s=0.5, limit_after=1, park_after=1,
+                    unpark_below_s=0.25, shed_queue_depth=3)
+    qos = QosController(default=QosPolicy(), eval_every_s=0.01,
+                        per_tenant={"victim": pol})
+    with obs_bus.scope() as bus:
+        ages = {}
+        bus.watermarks.backlog_age = lambda tid: ages.get(tid, 0.0)
+        eng = MultiTenantEngine(merge_every=1, qos=qos, poll_s=0.01)
+        eng.add_tier("cc", cc, CHUNK)
+        eng.admit("victim", "cc")
+        eng.admit("other", "cc")
+        vic = list(_stream(1, n_edges=256))
+        oth = list(_stream(2, n_edges=256))
+        eng.start()
+        try:
+            for ch in vic[:2]:
+                eng.submit("victim", ch)
+            for ch in oth[:2]:
+                eng.submit("other", ch)
+            assert _wait(lambda: eng.position("victim") == 2
+                         and eng.position("other") == 2)
+            _park_victim(bus, eng, ages)
+            # Overload the parked tenant past its shed depth.
+            for ch in vic[2:8]:  # 6 queued > shed_queue_depth=3
+                eng.submit("victim", ch)
+            assert _wait(lambda: eng.qos_state("victim") == QOS_SHED)
+            assert bus.counters["qos.shed"] == 1
+            assert bus.counters["qos.chunks_dropped"] == 6
+            # Bounded backlog: the dropped queue is gone, and the shed
+            # stream takes no more chunks.
+            assert eng.queue_depth("victim") == 0
+            with pytest.raises(ValueError, match="finished"):
+                eng.submit("victim", vic[2])
+            # The shed tenant's folded prefix still answers.
+            want_prefix = np.asarray(
+                edge_stream_from_edges(
+                    _edges(1, 256)[:64], vertex_capacity=N_V,
+                    chunk_size=CHUNK,
+                ).aggregate(cc, merge_every=1).result()
+            )
+            assert eng.labels("victim").tobytes() == want_prefix.tobytes()
+            # The survivor completes bit-identically.
+            ages["other"] = 0.0
+            for ch in oth[2:]:
+                eng.submit("other", ch)
+            eng.finish("other")
+            want = np.asarray(
+                _stream(2, n_edges=256).aggregate(cc, merge_every=1).result()
+            )
+            assert _wait(
+                lambda: eng.labels("other") is not None
+                and eng.labels("other").tobytes() == want.tobytes()
+            )
+            assert bus.gauges.get("qos.shed_tenants") == 1
+        finally:
+            eng.stop()
+
+
+def test_on_qos_hooks_see_every_transition():
+    cc = _cc_plan()
+    pol = QosPolicy(backlog_budget_s=0.5, limit_after=1, park_after=1,
+                    unpark_below_s=0.25, unpark_grace_s=0.0)
+    qos = QosController(default=QosPolicy(), eval_every_s=0.01,
+                        per_tenant={"victim": pol})
+    with obs_bus.scope() as bus:
+        ages = {}
+        bus.watermarks.backlog_age = lambda tid: ages.get(tid, 0.0)
+        eng = MultiTenantEngine(merge_every=1, qos=qos, poll_s=0.01)
+        eng.add_tier("cc", cc, CHUNK)
+        seen = []
+        eng.on_qos.append(lambda tid, action, info: seen.append(
+            (tid, action)))
+        eng.admit("victim", "cc")
+        eng.admit("other", "cc")
+        vic = list(_stream(1, n_edges=256))
+        eng.start()
+        try:
+            for ch in vic[:2]:
+                eng.submit("victim", ch)
+            assert _wait(lambda: eng.position("victim") == 2)
+            _park_victim(bus, eng, ages)
+            ages["victim"] = 0.0
+            ages["other"] = 0.0
+            assert _wait(
+                lambda: ("victim", "unpark") in seen)
+            actions = [a for t, a in seen if t == "victim"]
+            assert actions[:3] == ["limit", "park", "unpark"]
+        finally:
+            eng.stop()
