@@ -5,9 +5,17 @@ Usage: python examples/connected_components.py [--checkpoint-dir=DIR]
            [--trace-out=PATH] [--shards=S]
            [--queries=cc,degrees,bipartiteness]
            [--serve=PORT | --connect=HOST:PORT] [--compressed] [--stats]
-           [--auth-token=TOKEN]
+           [--auth-token=TOKEN] [--stack=K] [--stack-ms=MS]
            [<edges path> <merge every chunks>]
 Prints (vertex, component) pairs after each merge window.
+
+``--stack=K`` (with ``--connect``) coalesces K chunk payloads into one
+STACKED wire frame — one header/CRC/recv/fold-dispatch per K chunks
+instead of per chunk (README "Ingestion", stacked frames).
+``--stack-ms=MS`` bounds how long a partial stack may wait before it
+flushes anyway (latency floor for trickling streams); the final
+partial tail always drains on flush. Composable with ``--compressed``
+(stacks carry either payload kind).
 
 ``--auth-token=TOKEN`` (with ``--serve``/``--connect``) arms the wire's
 pre-shared-key handshake: the server answers a bare HELLO with an
@@ -116,12 +124,16 @@ def _wire_codec_plan():
     return connected_components(_WIRE_CAPACITY, codec="sparse")
 
 
-def _connect_main(target, rest, compressed=False, auth_token=None):
+def _connect_main(target, rest, compressed=False, auth_token=None,
+                  stack=None, stack_ms=None):
     """Stream the edge file (or the default data) to a --serve peer.
     With ``--compressed``, each chunk is reduced CLIENT-SIDE to its
     sparse spanning-forest pairs (the plan's ingest codec) and shipped
     as a DATA_COMPRESSED frame — the server folds the payload directly,
-    paying zero compress time (README "Ingestion")."""
+    paying zero compress time (README "Ingestion"). With ``--stack=K``
+    the client coalesces K payloads per STACKED frame (one
+    header/CRC/recv/fold-dispatch each); ``--stack-ms`` caps a partial
+    stack's wait."""
     import numpy as np
 
     from gelly_tpu.ingest import IngestClient
@@ -135,7 +147,13 @@ def _connect_main(target, rest, compressed=False, auth_token=None):
         edges = sequence_default_edges()
         src = np.asarray([e[0] for e in edges], dtype=np.int64)
         dst = np.asarray([e[1] for e in edges], dtype=np.int64)
-    cli = IngestClient(host, int(port), auth_token=auth_token).connect()
+    kw = {}
+    if stack is not None:
+        kw["stack"] = stack
+    if stack_ms is not None:
+        kw["stack_ms"] = stack_ms
+    cli = IngestClient(host, int(port), auth_token=auth_token,
+                       **kw).connect()
     if compressed:
         from gelly_tpu.core.chunk import make_chunk
 
@@ -156,8 +174,13 @@ def _connect_main(target, rest, compressed=False, auth_token=None):
         kind = "raw-edge"
     cli.flush(timeout=60)
     cli.close()  # BYE ends the server's stream
-    print(f"# streamed {src.shape[0]} edges in {frames} CRC-checked "
-          f"{kind} frames; server acked {cli.acked}")
+    if stack:
+        print(f"# streamed {src.shape[0]} edges: {frames} {kind} "
+              f"chunks coalesced into STACKED frames (stack={stack}); "
+              f"server acked {cli.acked}")
+    else:
+        print(f"# streamed {src.shape[0]} edges in {frames} CRC-checked "
+              f"{kind} frames; server acked {cli.acked}")
 
 
 def _serve_compressed_main(port, merge_every, trace_out,
@@ -293,6 +316,8 @@ def main(args):
     compressed = False
     stats = False
     auth_token = None
+    stack = None
+    stack_ms = None
     rest = []
     for a in args:
         if a.startswith("--checkpoint-dir="):
@@ -319,6 +344,10 @@ def main(args):
             stats = True
         elif a.startswith("--auth-token="):
             auth_token = a.split("=", 1)[1]
+        elif a.startswith("--stack="):
+            stack = int(a.split("=", 1)[1])
+        elif a.startswith("--stack-ms="):
+            stack_ms = float(a.split("=", 1)[1])
         else:
             rest.append(a)
     if ckpt_dir is not None and (
@@ -362,9 +391,16 @@ def main(args):
             "pair it with --serve or --connect (both sides must pass "
             "the same token)"
         )
+    if (stack is not None or stack_ms is not None) and connect is None:
+        raise SystemExit(
+            "--stack/--stack-ms configure the CLIENT's frame "
+            "coalescing (K payloads per STACKED wire frame); pair "
+            "them with --connect"
+        )
     if connect is not None:
         return _connect_main(connect, rest, compressed=compressed,
-                             auth_token=auth_token)
+                             auth_token=auth_token, stack=stack,
+                             stack_ms=stack_ms)
     if serve is not None and (ckpt_dir is not None or shards is not None):
         raise SystemExit(
             "--serve ingests from the wire — it cannot also read a "

@@ -1573,15 +1573,29 @@ def run_aggregation(
                     wm.stamp("stream", chunks_consumed - 1)
                 yield chunk
 
+        # Exact 0-based stream position of each produced unit's first
+        # chunk (written before the unit is yielded, read by stage_unit
+        # possibly on a worker thread — strictly happens-after). Needed
+        # because pre-grouped stacked units make unit sizes VARIABLE,
+        # so ``skip_until + seq * batch`` no longer reconstructs the
+        # position; the provider path keeps its wm_alloc counter.
+        unit_base: dict = {}
+
         def produced_units():
             # Batched producer for merge_every mode: groups of up to
             # ``batch`` host chunks, numbered in stream order (the seq
             # feeds ordered stackers). Resume-skipped chunks are dropped
             # here (they were consumed in the checkpointed run;
-            # chunks_consumed starts at skip_until).
+            # chunks_consumed starts at skip_until). A LIST stream item
+            # is a pre-grouped staged unit — a STACKED wire frame
+            # (``IngestServer.compressed_payload_units`` /
+            # ``chunk_units``) — and is yielded as its own unit: one
+            # fold dispatch per frame, never re-split or merged with
+            # neighbouring chunks.
             idx = 0
             seq = 0
             group: list = []
+            group_lo = 0
             it = iter(stream)
             t_unit = tracer.now() if tracer is not None else 0.0
             while True:
@@ -1589,11 +1603,55 @@ def run_aggregation(
                     chunk = next(it, None)
                 if chunk is None:
                     break
+                if isinstance(chunk, list):
+                    # Pre-grouped unit. Flush the accumulated per-chunk
+                    # group first (stream order is the fold order).
+                    if group:
+                        unit_base[seq] = group_lo
+                        if tracer is not None:
+                            tracer.span("produce", "produce", t_unit,
+                                        unit=seq, chunks=len(group))
+                        yield seq, group
+                        seq += 1
+                        group = []
+                        if tracer is not None:
+                            t_unit = tracer.now()
+                    lo = idx
+                    idx += len(chunk)
+                    if idx <= skip_until:
+                        continue  # whole unit folded pre-checkpoint
+                    if lo < skip_until:
+                        # Mid-frame resume: the checkpoint position
+                        # landed INSIDE this frame. The wire re-delivers
+                        # the covering frame; only the unseen suffix
+                        # folds — the exactly-once contract at chunk
+                        # granularity over frame-granularity redelivery.
+                        chunk = chunk[skip_until - lo:]
+                        lo = skip_until
+                    if len(chunk) > batch:
+                        raise ValueError(
+                            f"stacked unit of {len(chunk)} chunks "
+                            f"exceeds fold_batch {batch} — size the "
+                            "consumer's fold_batch to at least the wire "
+                            "stack size (client stack=K)"
+                        )
+                    unit_base[seq] = lo
+                    if tracer is not None:
+                        tracer.span("produce", "produce", t_unit,
+                                    unit=seq, chunks=len(chunk))
+                    yield seq, chunk
+                    seq += 1
+                    if tracer is not None:
+                        t_unit = tracer.now()
+                    continue
                 idx += 1
                 if idx <= skip_until:
                     continue
+                if not group:
+                    group_lo = idx - 1
                 group.append(chunk)
                 if len(group) == batch:
+                    unit_base[seq] = group_lo
                     if tracer is not None:
                         tracer.span("produce", "produce", t_unit,
                                     unit=seq, chunks=batch)
@@ -1603,6 +1661,7 @@ def run_aggregation(
                     if tracer is not None:
                         t_unit = tracer.now()
             if group:
+                unit_base[seq] = group_lo
                 if tracer is not None:
                     tracer.span("produce", "produce", t_unit,
                                 unit=seq, chunks=len(group))
@@ -1645,6 +1704,9 @@ def run_aggregation(
             # the H2D span (buffer slot) and the fold span all carry it,
             # so a stalled chunk is attributable end to end.
             seq, group = unit
+            # Pop unconditionally — with telemetry off nothing else
+            # would, and the map must not grow with the stream.
+            unit_base_seq = unit_base.pop(seq, None)
             if wm is not None:
                 # Ingress stamp at reader parse/staging time (both the
                 # single-iterator and sharded-provider paths stage
@@ -1659,7 +1721,11 @@ def run_aggregation(
                     for _ in range(len(group)):
                         wm.stamp("stream", wm_alloc())
                 else:
-                    base = skip_until + seq * batch
+                    # Exact recorded base (variable-size stacked units
+                    # broke the uniform seq × batch arithmetic).
+                    base = unit_base_seq
+                    if base is None:
+                        base = skip_until + seq * batch
                     for j in range(len(group)):
                         wm.stamp("stream", base + j)
             try:

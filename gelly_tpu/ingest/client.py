@@ -22,6 +22,20 @@ The sending half of ``ingest/server.py``'s delivery contract:
   frame, not at the next backpressure poll.
 - **Pre-shared-key auth** (``auth_token=``): the handshake answers the
   server's AUTH_CHALLENGE nonce with an HMAC-SHA256 proof.
+- **Adaptive coalescing** (``stack=K`` / ``stack_bytes=`` /
+  ``stack_ms=``): payloads buffer client-side and ship as ONE
+  ``STACKED`` frame — one header, one CRC, one send syscall, one
+  server staging admission per K chunks — flushed when K payloads
+  accumulate, the byte ceiling is reached, or the oldest buffered
+  payload ages past the deadline (a background
+  ``gelly-ingest-client-stack`` thread owns the age flush).
+  :meth:`flush` drains the partial tail unconditionally before
+  waiting on acks (the batched-ack-tail lesson), and the resend
+  buffer holds whole framed stacks: an ack releases a stack only once
+  it covers the frame's LAST position, and a reconnect retransmits
+  the covering frame whole when the server's expected seq lands
+  mid-frame (the server drops the already-durable prefix payloads).
+  Stacks never mix tenants — each stream key buffers separately.
 
 A background reader thread (``gelly-ingest-client-rx``) owns every
 incoming frame; protocol state is lock-guarded and ack progress is
@@ -75,7 +89,9 @@ class IngestClient:
                  connect_timeout: float = 5.0,
                  send_pause_timeout: float = 30.0,
                  auth_token: str | None = None,
-                 tenant_streams: bool = False):
+                 tenant_streams: bool = False,
+                 stack: int = 1, stack_bytes: int | None = None,
+                 stack_ms: float | None = None):
         self.host = host
         self.port = port
         self.connect_timeout = connect_timeout
@@ -84,13 +100,42 @@ class IngestClient:
         self.auth_token = auth_token
         # Per-tenant sequence spaces (must match the server's mode).
         self.tenant_streams = bool(tenant_streams)
+        # Adaptive coalescing: buffer up to ``stack`` payloads (and at
+        # most ``stack_bytes`` of packed payload) per stream key and
+        # ship them as ONE STACKED frame; ``stack_ms`` bounds how long
+        # the oldest buffered payload may wait before the age thread
+        # flushes the partial stack. stack=1 with no deadline disables
+        # coalescing entirely (every payload ships as a legacy frame).
+        self.stack = int(stack)
+        if self.stack < 1 or self.stack > wire.MAX_STACK:
+            raise ValueError(
+                f"stack must be in 1..{wire.MAX_STACK}, got {stack}"
+            )
+        self.stack_bytes = None if stack_bytes is None else int(stack_bytes)
+        if self.stack_bytes is not None and self.stack_bytes < 1:
+            raise ValueError(
+                f"stack_bytes must be >= 1, got {stack_bytes}"
+            )
+        self.stack_ms = None if stack_ms is None else float(stack_ms)
+        if self.stack_ms is not None and self.stack_ms <= 0:
+            raise ValueError(f"stack_ms must be > 0, got {stack_ms}")
+        # stream_key -> [base_seq, [(payload_bytes, compressed), ...],
+        # packed_bytes_total, oldest_monotonic] — payloads buffered but
+        # not yet framed/sent. Guarded by _lock; drained by the K/byte
+        # triggers in send(), the age thread, and flush()'s
+        # unconditional tail drain.
+        self._stack_buf: dict = {}
+        self._stack_evt = threading.Event()  # stops the age thread
+        self._stack_thread: threading.Thread | None = None
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._send_lock = threading.Lock()
-        # (stream_key, seq) -> framed bytes, pruned as (scoped) acks
-        # arrive. stream_key None = the legacy single stream; an int =
-        # one tenant's seq space (tenant_streams mode).
+        # (stream_key, base_seq) -> (framed bytes, chunk count): the
+        # resend buffer at FRAME granularity — a frame covers positions
+        # [base_seq, base_seq + count) and is pruned only once an ack
+        # covers its LAST position. stream_key None = the legacy single
+        # stream; an int = one tenant's seq space (tenant_streams).
         self._unacked: dict = {}
         # Per-stream next seq / acked position, same keying.
         self._next: dict = {None: 0}
@@ -197,6 +242,15 @@ class IngestClient:
             name="gelly-ingest-client-rx",
         )
         self._rx_thread.start()
+        if self.stack_ms is not None:
+            t = self._stack_thread
+            if t is None or not t.is_alive():
+                self._stack_evt.clear()
+                self._stack_thread = threading.Thread(
+                    target=self._stack_age_loop, daemon=True,
+                    name="gelly-ingest-client-stack",
+                )
+                self._stack_thread.start()
         return self
 
     def reconnect(self) -> "IngestClient":
@@ -222,6 +276,12 @@ class IngestClient:
             except IngestError:
                 pass
         self._teardown_socket()
+        # Stop the age-deadline flusher (restarted by a later
+        # connect()): LV401 — the stop event plus a bounded join.
+        self._stack_evt.set()
+        t = self._stack_thread
+        if t is not None:
+            t.join(timeout=1.0)
 
     def __enter__(self):
         return self.connect()
@@ -264,25 +324,164 @@ class IngestClient:
             )
         if key is not None:
             self._wait_tenant_flow(key)
+        if self._stacking:
+            return self._send_stacked(key, payload, compressed)
         ftype = wire.DATA_COMPRESSED if compressed else wire.DATA
         with self._lock:
             self._raise_rx_error_locked()
-            if key in self._shed:
-                raise IngestError(
-                    f"stream {'(default)' if key is None else key} was "
-                    f"shed by the server ({self._shed[key]}); the "
-                    "folded prefix below the NACK's durable position "
-                    "is safe — nothing further will be accepted"
-                )
+            self._raise_shed_locked(key)
             seq = self._next.setdefault(key, 0)
             frame = wire.pack_frame(
                 ftype, seq, wire.pack_payload(payload)
             )
-            self._unacked[(key, seq)] = frame
+            self._unacked[(key, seq)] = (frame, 1)
             self._next[key] = seq + 1
         self._raw_send(frame)
         obs_bus.get_bus().inc("ingest.frames_sent")
         return seq
+
+    @property
+    def _stacking(self) -> bool:
+        return (self.stack > 1 or self.stack_bytes is not None
+                or self.stack_ms is not None)
+
+    def _raise_shed_locked(self, key) -> None:
+        if key in self._shed:
+            raise IngestError(
+                f"stream {'(default)' if key is None else key} was "
+                f"shed by the server ({self._shed[key]}); the "
+                "folded prefix below the NACK's durable position "
+                "is safe — nothing further will be accepted"
+            )
+
+    def _send_stacked(self, key, payload: dict,
+                      compressed: bool) -> int:
+        """Coalescing :meth:`send`: buffer the packed payload under its
+        stream key and flush when K payloads accumulate or the byte
+        ceiling is hit (the age deadline is the background thread's
+        trigger; :meth:`flush` drains any partial tail). Positions are
+        assigned AT BUFFER TIME, so the flushed frame's base seq plus
+        its payload count exactly tiles the stream's seq space."""
+        blob = wire.pack_payload(payload)
+        flush_reason = None
+        while True:
+            flush_first = False
+            with self._lock:
+                self._raise_rx_error_locked()
+                self._raise_shed_locked(key)
+                buf = self._stack_buf.get(key)
+                if buf is not None and buf[1]:
+                    # Exact stacked-body bound: count field + one table
+                    # entry per payload + the blobs. Appending past
+                    # MAX_PAYLOAD would make the eventual pack_stacked
+                    # raise with the payloads already popped — ship the
+                    # buffered stack FIRST, then buffer this payload.
+                    n = len(buf[1]) + 1
+                    body = 2 + 5 * n + buf[2] + len(blob)
+                    if body > wire.MAX_PAYLOAD:
+                        flush_first = True
+                if not flush_first:
+                    seq = self._next.setdefault(key, 0)
+                    self._next[key] = seq + 1
+                    if buf is None or not buf[1]:
+                        buf = self._stack_buf[key] = [
+                            seq, [], 0, time.monotonic()
+                        ]
+                    buf[1].append((blob, compressed))
+                    buf[2] += len(blob)
+                    if len(buf[1]) >= self.stack:
+                        flush_reason = "size"
+                    elif (self.stack_bytes is not None
+                          and buf[2] >= self.stack_bytes):
+                        flush_reason = "bytes"
+            if not flush_first:
+                break
+            self._flush_stack(key, reason="bytes")
+        if flush_reason is not None:
+            self._flush_stack(key, reason=flush_reason)
+        return seq
+
+    def _flush_stack(self, key, reason: str | None = None) -> None:
+        """Frame + transmit one stream key's buffered stack. A single
+        buffered payload ships as a legacy DATA/DATA_COMPRESSED frame
+        (K=1 needs no stack table); more ship as ONE STACKED frame
+        covering [base, base + K). The send lock is held across
+        register-and-send so a racing size-trigger flush and age-
+        thread flush cannot invert frame order on the wire."""
+        bus = obs_bus.get_bus()
+        with self._send_lock:
+            with self._lock:
+                buf = self._stack_buf.pop(key, None)
+                if buf is None or not buf[1] or key in self._shed:
+                    return
+                base, parts, nbytes, t0 = buf
+                if len(parts) == 1:
+                    blob, comp = parts[0]
+                    ftype = (wire.DATA_COMPRESSED if comp
+                             else wire.DATA)
+                    frame = wire.pack_frame(ftype, base, blob)
+                else:
+                    frame = wire.pack_frame(
+                        wire.STACKED, base, wire.pack_stacked(parts)
+                    )
+                self._unacked[(key, base)] = (frame, len(parts))
+                sock = self._sock
+            if reason == "size":
+                bus.inc("ingest.stack_flush_size")
+            elif reason == "bytes":
+                bus.inc("ingest.stack_flush_bytes")
+            elif reason == "age":
+                bus.inc("ingest.stack_flush_age")
+            if sock is None:
+                raise IngestError(
+                    "not connected (the stacked frame stays buffered "
+                    "for reconnect())"
+                )
+            try:
+                sock.sendall(frame)
+            except OSError as e:
+                raise IngestError(
+                    f"send failed ({e}); reconnect() to resume at the "
+                    "acked sequence"
+                ) from e
+        bus.inc("ingest.frames_sent")
+
+    def _drain_stack_tails(self) -> None:
+        """Unconditionally flush every stream key's partial stack (the
+        LV203 contract: the size/byte/age triggers are all threshold-
+        guarded, so :meth:`flush`/:meth:`close` must drain the tail
+        without one). Shed keys are skipped — the server would only
+        NACK the frames."""
+        with self._lock:
+            due = [k for k, buf in self._stack_buf.items()
+                   if buf[1] and k not in self._shed]
+        for key in due:
+            self._flush_stack(key)
+
+    def _stack_age_loop(self) -> None:
+        """Age-deadline flusher (``gelly-ingest-client-stack``): wakes
+        a few times per deadline and ships any stack whose OLDEST
+        payload has waited past ``stack_ms``. Paused/held/shed streams
+        are skipped — their stacks simply age until flow resumes (or
+        :meth:`flush` drains them). Send failures are swallowed: the
+        frame is already registered unacked, so reconnect replays
+        it."""
+        deadline = self.stack_ms / 1000.0
+        tick = max(0.001, deadline / 4)
+        while not self._stack_evt.wait(tick):
+            now = time.monotonic()
+            with self._lock:
+                due = [k for k, buf in self._stack_buf.items()
+                       if buf[1] and now - buf[3] >= deadline
+                       and k not in self._shed
+                       and k not in self._paused_tenants]
+            if not due or not self._resume_evt.is_set():
+                continue
+            for key in due:
+                try:
+                    self._flush_stack(key, reason="age")
+                except IngestError:
+                    pass  # disconnected: the frame rides the resend buffer
 
     def _wait_tenant_flow(self, key: int) -> None:
         """Block while ``key``'s stream is held by a tenant-scoped
@@ -367,7 +566,14 @@ class IngestClient:
         NON-SHED stream in tenant mode: a shed tenant's tail will
         never be acked and must not hang the flush); returns the acked
         seq (summed across tenants in tenant mode).
-        :class:`IngestError` on timeout."""
+        :class:`IngestError` on timeout.
+
+        With coalescing on, any PARTIAL stacks drain first —
+        unconditionally, no size/byte/age threshold — so a flush can
+        never hang waiting on acks for payloads still sitting in the
+        client's own buffer."""
+        if self._stacking:
+            self._drain_stack_tails()
         with self._cv:
             ok = self._cv.wait_for(self._flush_done_locked,
                                    timeout=timeout)
@@ -446,7 +652,12 @@ class IngestClient:
     def _rewind_to(self, server_next: int) -> None:
         """Align the legacy single stream with the server's expected
         seq after a (re)connect: prune frames the server already
-        staged, retransmit the rest."""
+        staged, retransmit the rest. Pruning is FRAME-granular: a
+        stacked frame is released only when ``server_next`` covers its
+        LAST position — an expected seq landing MID-frame (the
+        checkpoint position fell inside a stack) keeps the covering
+        frame, which is retransmitted whole and whose already-durable
+        prefix payloads the server drops on admission."""
         with self._lock:
             if server_next > self._next.get(None, 0):
                 raise IngestError(
@@ -462,10 +673,10 @@ class IngestClient:
                     "consistency"
                 )
             self._ackd[None] = server_next
-            for k in [k for k in self._unacked
-                      if k[0] is None and k[1] < server_next]:
+            for k in [k for k, v in self._unacked.items()
+                      if k[0] is None and k[1] + v[1] <= server_next]:
                 del self._unacked[k]
-            replay = [self._unacked[k] for k in sorted(
+            replay = [self._unacked[k][0] for k in sorted(
                 (k for k in self._unacked if k[0] is None),
                 key=lambda k: k[1])]
             self._cv.notify_all()
@@ -494,11 +705,14 @@ class IngestClient:
                     "consistency"
                 )
             self._ackd[tid] = server_next
-            for k in [k for k in self._unacked
-                      if k[0] == tid and k[1] < server_next]:
+            # Frame-granular pruning, same mid-frame rule as the
+            # legacy rewind: a stack is released only once covered to
+            # its LAST position; a straddled stack replays whole.
+            for k in [k for k, v in self._unacked.items()
+                      if k[0] == tid and k[1] + v[1] <= server_next]:
                 del self._unacked[k]
             replay = [] if tid in self._shed else [
-                self._unacked[k] for k in sorted(
+                self._unacked[k][0] for k in sorted(
                     (k for k in self._unacked if k[0] == tid),
                     key=lambda k: k[1])
             ]
@@ -528,7 +742,7 @@ class IngestClient:
         Duplicates are dropped + re-acked server-side, so over-sending
         is always safe; deleting here never is."""
         with self._lock:
-            replay = [self._unacked[k] for k in sorted(
+            replay = [self._unacked[k][0] for k in sorted(
                 (k for k in self._unacked if k[0] not in self._shed),
                 key=lambda k: (str(k[0]), k[1]))
             ]
@@ -553,8 +767,11 @@ class IngestClient:
                     with self._lock:
                         if seq > self._ackd.get(key, 0):
                             self._ackd[key] = seq
-                        for k in [k for k in self._unacked
-                                  if k[0] == key and k[1] < seq]:
+                        # Frame-granular release: a stacked frame
+                        # leaves the resend buffer only once the ack
+                        # covers its LAST position [base + count).
+                        for k in [k for k, v in self._unacked.items()
+                                  if k[0] == key and k[1] + v[1] <= seq]:
                             del self._unacked[k]
                         self._cv.notify_all()
                 elif ftype == wire.PAUSE:

@@ -27,6 +27,20 @@ Delivery contract:
   or a compressed tenant tier) and the server performs ZERO compress
   work. ``ingest.data_frames_raw`` / ``ingest.data_frames_compressed``
   count the two kinds.
+- **Stacked frames.** A ``STACKED`` frame carries K payloads (raw or
+  compressed per entry) behind ONE header/CRC/recv/staging admission,
+  covering sequence positions ``[seq, seq + K)``; it stages as ONE
+  queue unit so the whole stack rides the engine's existing
+  ``fold_many``/``fold_codec`` stacked dispatch — one fold dispatch
+  per frame, not per chunk. Duplicate/gap handling is whole-frame; a
+  frame whose prefix is already staged (the mid-frame checkpoint
+  resume) admits only the unseen suffix, keeping exactly-once at
+  chunk granularity. ``ingest.frames_stacked`` counts them and
+  ``ingest.chunks_per_stacked_frame`` records K. In
+  ``tenant_streams`` mode a stack must be single-tenant-scoped.
+  :meth:`IngestServer.frames` unstacks transparently;
+  :meth:`IngestServer.stacks` yields whole units for
+  frame-granularity consumers.
 - **Acks follow durability, not receipt.** With ``auto_ack=True``
   (lossy-tolerant pipelines) a frame is acked once enqueued. With
   ``auto_ack=False`` the CONSUMER calls :meth:`ack` after its own
@@ -281,13 +295,13 @@ class IngestServer:
 
     # ----------------------------------------------------------- consumer
 
-    def frames(self) -> Iterator[tuple[int, dict, bool]]:
-        """Yield ``(seq, payload_dict, compressed)`` in sequence order
-        until :meth:`stop` — ``compressed`` is True for
-        ``DATA_COMPRESSED`` frames (client-side-compressed codec
-        payloads the consumer folds directly, no server compress). The
-        bounded staging queue is the backpressure boundary: not
-        consuming stalls the wire, never memory."""
+    def _staged_units(self) -> Iterator[tuple]:
+        """Drain the staging queue: one item per STAGED UNIT — a plain
+        frame's ``(seq, payload_dict, compressed_bool)`` or a stacked
+        frame's ``(first_seq, [payload, ...], [compressed, ...])`` (the
+        list-typed third element is the discriminator). The bounded
+        queue is the backpressure boundary either way; a stacked frame
+        occupies ONE slot."""
         import queue as queue_mod
 
         while True:
@@ -300,6 +314,80 @@ class IngestServer:
             if item is _DONE:
                 return
             yield item
+
+    def frames(self) -> Iterator[tuple[int, dict, bool]]:
+        """Yield ``(seq, payload_dict, compressed)`` in sequence order
+        until :meth:`stop` — ``compressed`` is True for
+        ``DATA_COMPRESSED`` frames (client-side-compressed codec
+        payloads the consumer folds directly, no server compress). The
+        bounded staging queue is the backpressure boundary: not
+        consuming stalls the wire, never memory. STACKED frames are
+        unstacked transparently here — one yield per carried payload,
+        positions tiling ``[first_seq, first_seq + K)`` — so per-chunk
+        consumers never see frame boundaries; consumers that want the
+        frame-granularity unit (one fold dispatch per frame) iterate
+        :meth:`stacks` instead."""
+        for item in self._staged_units():
+            seq, payload, compressed = item
+            if isinstance(compressed, list):
+                for i, (p, c) in enumerate(zip(payload, compressed)):
+                    yield seq + i, p, c
+            else:
+                yield item
+
+    def stacks(self) -> Iterator[tuple[int, list, list]]:
+        """Yield ``(first_seq, [payload, ...], [compressed, ...])`` —
+        one item per staged unit, in sequence order. A plain DATA /
+        DATA_COMPRESSED frame yields a 1-payload unit; a STACKED frame
+        yields its whole (possibly prefix-dropped) stack. This is the
+        frame-granularity consumer: each unit is exactly one staging
+        admission, and feeding units whole to the engine keeps ONE
+        fold dispatch per frame."""
+        for item in self._staged_units():
+            seq, payload, compressed = item
+            if isinstance(compressed, list):
+                yield seq, payload, compressed
+            else:
+                yield seq, [payload], [compressed]
+
+    def compressed_payload_units(self) -> Iterator[list]:
+        """Yield each staged unit as a LIST of pre-compressed codec
+        payloads — the stream shape ``run_aggregation(...,
+        precompressed=True)`` folds with one dispatch per unit (a
+        list item is a pre-grouped fold batch there). A raw DATA
+        payload anywhere in the stream is a protocol error, same
+        contract as :meth:`compressed_payloads`."""
+        for seq, payloads, flags in self.stacks():
+            for i, c in enumerate(flags):
+                if not c:
+                    raise ValueError(
+                        f"raw DATA payload at seq {seq + i} on a "
+                        "compressed-payload consumer — the client must "
+                        "compress before send (send_compressed); mixing "
+                        "raw and compressed chunks in one stream has no "
+                        "single fold to land in"
+                    )
+            yield payloads
+
+    def chunk_units(self, capacity: int,
+                    vertex_capacity: int | None = None) -> Iterator[list]:
+        """Yield each staged unit as a LIST of padded EdgeChunks (see
+        :func:`payload_to_chunk`) — the raw-edge twin of
+        :meth:`compressed_payload_units`: one list per frame keeps one
+        ``fold_many`` dispatch per frame downstream. A compressed
+        payload anywhere in the stream raises, same contract as
+        :meth:`chunks`."""
+        for seq, payloads, flags in self.stacks():
+            for i, c in enumerate(flags):
+                if c:
+                    raise ValueError(
+                        f"compressed DATA payload at seq {seq + i} on a "
+                        "raw-chunk consumer — consume "
+                        "compressed_payload_units() with a codec plan "
+                        "instead"
+                    )
+            yield [payload_to_chunk(p, capacity, vertex_capacity)
+                   for p in payloads]
 
     def payloads(self) -> Iterator[tuple[int, dict]]:
         """Yield ``(seq, payload_dict)`` in sequence order until
@@ -628,6 +716,15 @@ class IngestServer:
                     if self.stop_on_bye:
                         self.stop()
                     return
+                if ftype == wire.STACKED:
+                    # K chunks behind ONE header/CRC/admission: the
+                    # frame covers positions [seq, seq + K) and stages
+                    # as one unit (one fold dispatch downstream).
+                    self._adopt(sock)
+                    if not self._stacked_data(sock, bus, tracer, seq,
+                                              payload, telemetry, t_rx):
+                        return  # stopped while staging
+                    continue
                 if ftype not in (wire.DATA, wire.DATA_COMPRESSED):
                     continue  # unexpected control frame: ignore
                 self._adopt(sock)
@@ -815,6 +912,152 @@ class IngestServer:
             # Per-tenant acks are unbatched (ack_every applies to the
             # legacy single-stream path): each tenant's flush() waits
             # on its OWN space, so a remainder could strand it.
+            self._send(sock, wire.pack_frame(wire.ACK, acked, env))
+            bus.inc("ingest.acks_sent")
+        return True
+
+    def _stacked_data(self, sock, bus, tracer, seq: int, payload: bytes,
+                      telemetry: bool, t_rx: float) -> bool:
+        """One STACKED frame (legacy or tenant mode): K payloads behind
+        one header/CRC, covering sequence positions ``[seq, seq + K)``.
+        Admission is whole-frame against the stream's expected
+        position ``e``:
+
+        - ``seq + K <= e`` — whole-frame reconnect replay: drop,
+          re-ack (``ingest.frames_duplicate``).
+        - ``seq > e`` — gap: REJECT with the expected seq; the client
+          rewinds its frame-granularity resend buffer to the COVERING
+          frame (its base may be below ``e`` — the overlap case below
+          absorbs that).
+        - ``seq <= e < seq + K`` — admit: the prefix ``[seq, e)`` is
+          already staged (possibly durable — the mid-frame checkpoint
+          resume case), so those payloads are DROPPED here and only
+          ``[e, seq + K)`` stages, as ONE queue unit. Exactly-once
+          holds at chunk granularity even though retransmission is
+          frame-granular.
+
+        Tenant mode adds: the stack must be single-tenant-scoped
+        (every payload names the same tenant) — per-tenant seq spaces,
+        checkpoint-gated acks, and shed NACKs are untouched because a
+        frame never straddles sequence spaces. Returns False only when
+        staging stopped. Reached only after the conn loop's CRC guard."""
+        if self.tenant_streams:
+            reject = wire.pack_frame(
+                wire.REJECT, 0, wire.pack_json({"resync": True}))
+        else:
+            with self._state_lock:
+                expect0 = self._next_seq
+            reject = wire.pack_frame(wire.REJECT, expect0)
+        try:
+            parts = wire.unpack_stacked(payload)
+            datas = [wire.unpack_payload(b) for b, _c in parts]
+        except wire.FrameError as e:
+            bus.inc("ingest.frames_rejected")
+            logger.warning("malformed stacked frame seq=%d: %s", seq, e)
+            self._send(sock, reject)
+            return True
+        flags = [c for _b, c in parts]
+        k = len(datas)
+        env = b""
+        tid = None
+        if self.tenant_streams:
+            tids = set()
+            for d in datas:
+                wt = d.get("tenant")
+                tids.add(None if wt is None
+                         else int(np.asarray(wt).reshape(-1)[0]))
+            if len(tids) != 1 or None in tids:
+                # A stack that straddles (or omits) tenant ids has no
+                # single sequence space to land in — refuse it whole;
+                # partial admission would tear per-tenant exactly-once.
+                bus.inc("ingest.chunks_unroutable")
+                logger.warning(
+                    "stacked frame seq=%d is not single-tenant-scoped "
+                    "(tenants=%s); dropped", seq,
+                    sorted(str(t) for t in tids),
+                )
+                return True
+            tid = tids.pop()
+            env = wire.pack_json({"tenant": tid})
+            with self._state_lock:
+                st = self._tseq.setdefault(tid, [0, 0, 0])
+                expect, acked, durable = st
+                shed = self._tenant_shed.get(tid)
+            if shed is not None:
+                bus.inc("ingest.frames_shed")
+                bus.inc("ingest.nacks_sent")
+                self._send(sock, wire.pack_frame(
+                    wire.NACK, durable,
+                    wire.pack_json({"tenant": tid, "reason": shed})))
+                return True
+        else:
+            with self._state_lock:
+                expect = self._next_seq
+                acked = self._acked
+        if seq + k <= expect:
+            # Whole-frame reconnect replay: every position is already
+            # staged. Drop and re-ack, same as a duplicate DATA frame.
+            bus.inc("ingest.frames_duplicate")
+            self._send(sock, wire.pack_frame(wire.ACK, acked, env))
+            return True
+        if seq > expect:
+            bus.inc("ingest.frames_rejected")
+            self._send(sock, wire.pack_frame(wire.REJECT, expect, env))
+            return True
+        # seq <= expect < seq + k: admit. Drop the already-staged
+        # prefix [seq, expect) — the mid-frame resume case: the
+        # consumer's checkpoint (and ack) landed inside the frame, the
+        # client retransmitted the COVERING frame, and re-staging the
+        # durable prefix would double-fold it.
+        drop = expect - seq
+        if drop:
+            logger.debug(
+                "stacked frame seq=%d: dropping %d already-staged "
+                "prefix payload(s), staging [%d, %d)", seq, drop,
+                expect, seq + k,
+            )
+        datas = datas[drop:]
+        flags = flags[drop:]
+        stage_seq = expect
+        if telemetry:
+            # Ingress stamp BEFORE the admission wait, under the state
+            # lock against a concurrent attach rekey — one stamp per
+            # CHUNK position (the watermark ledger retires chunkwise),
+            # same contract as the per-frame paths.
+            with self._state_lock:
+                led = (self.wire_ledger(tid) if tid is not None
+                       else self.watermark_stream)
+                for j in range(len(datas)):
+                    bus.watermarks.stamp(led, stage_seq + j)
+        self._apply_backpressure(sock, bus)
+        if not self._enqueue((stage_seq, datas, flags)):
+            return False
+        with self._state_lock:
+            if tid is not None:
+                st = self._tseq[tid]
+                st[0] = seq + k
+                if self.auto_ack:
+                    st[1] = seq + k
+                acked = st[1]
+            else:
+                self._next_seq = seq + k
+                if self.auto_ack:
+                    self._acked = seq + k
+                acked = self._acked
+        bus.inc("ingest.frames_stacked")
+        bus.inc("ingest.chunks_enqueued", len(datas))
+        bus.observe("ingest.chunks_per_stacked_frame", k)
+        if telemetry:
+            bus.observe("ingest.receive_to_stage_ms",
+                        (time.perf_counter() - t_rx) * 1e3)
+        bus.gauge("ingest.staged_depth", self._q.qsize())
+        if tracer is not None:
+            tracer.instant("ingest.chunk_staged", track="ingest",
+                           seq=stage_seq, stack=k, bytes=len(payload))
+        if self.auto_ack:
+            # Acks are frame-granular on the stacked path: the frame
+            # IS the batch, so ack_every batching on top of it would
+            # only strand the client's flush() behind a remainder.
             self._send(sock, wire.pack_frame(wire.ACK, acked, env))
             bus.inc("ingest.acks_sent")
         return True
@@ -1119,63 +1362,82 @@ class TenantRouter:
     def _drain_loop(self, server: IngestServer, default_tenant) -> None:
         bus = obs_bus.get_bus()
         chunk_capacity = self.engine.chunk_capacity(self.tier)
-        for seq, payload, compressed in server.frames():
+        # Drain at STAGED-UNIT granularity (server.stacks()): a STACKED
+        # frame's whole K-chunk payload is submitted in one round, so
+        # the engine's chunk-granular queues — and therefore DRR credit
+        # accounting — see K chunks, not one frame, while the gauge and
+        # ledger retire move once per frame.
+        for base_seq, payloads, flags in server.stacks():
             if self._stop.is_set():
                 break
-            # Per-payload containment: a malformed payload (out-of-range
-            # ids, wrong shapes, a finished tenant) must drop THAT chunk
-            # — observably — not kill the drain thread while the server
-            # keeps staging and (auto_ack) ACK-ing frames nobody folds.
-            try:
-                wire_tenant = payload.pop("tenant", None)
-                tid = (
-                    default_tenant if wire_tenant is None
-                    else self._tenant_of(wire_tenant)
-                )
-                if tid is None or not self._ensure_admitted(tid):
-                    bus.inc("ingest.chunks_unroutable")
+            routed_tid = None
+            for i, payload in enumerate(payloads):
+                seq = base_seq + i
+                # Per-payload containment: a malformed payload
+                # (out-of-range ids, wrong shapes, a finished tenant)
+                # must drop THAT chunk — observably — not kill the
+                # drain thread (or the rest of its stack) while the
+                # server keeps staging and (auto_ack) ACK-ing frames
+                # nobody folds.
+                try:
+                    wire_tenant = payload.pop("tenant", None)
+                    tid = (
+                        default_tenant if wire_tenant is None
+                        else self._tenant_of(wire_tenant)
+                    )
+                    if tid is None or not self._ensure_admitted(tid):
+                        bus.inc("ingest.chunks_unroutable")
+                        logger.warning(
+                            "unroutable ingest payload (tenant=%r, no "
+                            "default); dropped", wire_tenant,
+                        )
+                        continue
+                    with self._admit_lock:
+                        self._tenant_server[tid] = server
+                    if flags[i]:
+                        # Client-side-compressed payload straight into
+                        # the compressed tier's queue: no
+                        # payload_to_chunk, no server-side compress —
+                        # the engine folds exactly the bytes the
+                        # producer shipped (a raw tier refuses it
+                        # below, counted invalid).
+                        self.engine.submit_payload(tid, payload)
+                    else:
+                        chunk = payload_to_chunk(
+                            payload, chunk_capacity, self.vertex_capacity
+                        )
+                        self.engine.submit(tid, chunk)
+                    routed_tid = tid
+                except Exception as e:  # noqa: BLE001
+                    bus.inc("ingest.chunks_invalid")
                     logger.warning(
-                        "unroutable ingest payload (tenant=%r, no "
-                        "default); dropped", wire_tenant,
+                        "invalid ingest payload seq=%d dropped (%s: %s)",
+                        seq, type(e).__name__, e,
                     )
                     continue
-                with self._admit_lock:
-                    self._tenant_server[tid] = server
-                if compressed:
-                    # Client-side-compressed payload straight into the
-                    # compressed tier's queue: no payload_to_chunk, no
-                    # server-side compress — the engine folds exactly
-                    # the bytes the producer shipped (a raw tier
-                    # refuses it below, counted invalid).
-                    self.engine.submit_payload(tid, payload)
-                else:
-                    chunk = payload_to_chunk(
-                        payload, chunk_capacity, self.vertex_capacity
-                    )
-                    self.engine.submit(tid, chunk)
-            except Exception as e:  # noqa: BLE001
-                bus.inc("ingest.chunks_invalid")
-                logger.warning(
-                    "invalid ingest payload seq=%d dropped (%s: %s)",
-                    seq, type(e).__name__, e,
-                )
+            if routed_tid is None:
                 continue
             # The one shared gauge: every attached server's admission
             # check reads it, so wire backpressure tracks the WHOLE
             # engine backlog across all N client streams. (The engine's
             # scheduler loop re-publishes it as queues DRAIN —
             # publish_staged_gauge below — so a paused client can't
-            # strand the gauge above low_water.)
+            # strand the gauge above low_water.) Once per staged unit,
+            # not per chunk — the frame is the admission quantum.
             bus.gauge("pipeline.staged_depth", self.engine.queue_depth())
             if obs_bus.telemetry_on():
-                # Routed into a per-tenant queue: the per-tenant ledger
+                # Routed into per-tenant queues: the per-tenant ledger
                 # (stamped by engine.submit*) owns the e2e watermark
                 # from here; drain this server's wire ledger so it
                 # never reads as backlog nobody will retire. Tenant-
                 # streams servers stamp under per-tenant sub-keys (the
-                # seq is scoped to the tenant), so retire matches.
+                # seq is scoped to the tenant) AND enforce single-
+                # tenant stacks, so retiring the whole frame range
+                # under the last routed tenant's ledger matches every
+                # stamp the staging path made for it.
                 bus.watermarks.retire_durable(
-                    server.wire_ledger(tid), seq + 1)
+                    server.wire_ledger(routed_tid),
+                    base_seq + len(payloads))
 
 
 class _ConnClosed(Exception):

@@ -12,11 +12,14 @@ Frame layout (network byte order)::
 
     magic  u16   0x4749 ("GI")
     type   u8    HELLO/WELCOME/DATA/ACK/REJECT/PAUSE/RESUME/BYE/
-                 DATA_COMPRESSED/STATS/NACK/AUTH_CHALLENGE/AUTH_FAIL
+                 DATA_COMPRESSED/STATS/NACK/AUTH_CHALLENGE/AUTH_FAIL/
+                 STACKED
     flags  u8    reserved (0)
     seq    u64   per-stream sequence number (DATA/DATA_COMPRESSED: the
-                 chunk position; ACK/REJECT/WELCOME: the position being
-                 acknowledged / expected)
+                 chunk position; STACKED: the FIRST stacked payload's
+                 chunk position — the frame covers [seq, seq + K);
+                 ACK/REJECT/WELCOME: the position being acknowledged /
+                 expected)
     len    u32   payload byte length
     crc    u32   zlib.crc32 of the payload bytes
 
@@ -84,9 +87,25 @@ AUTH_CHALLENGE = 12
 # non-handshake frame before authentication. Terminal — the server
 # closes the connection after sending it.
 AUTH_FAIL = 13
+# One frame carrying K chunk payloads (client -> server): the stack
+# body is a count, a per-payload (kind, length) table, and the K
+# concatenated ``pack_payload`` blobs — so ONE 20-byte header, ONE
+# CRC32 (the frame header's, over the whole packed stack), ONE
+# send/recv pair and ONE staging admission cover K chunks. The frame's
+# seq is the FIRST payload's stream position; the frame covers
+# positions ``[seq, seq + K)`` on the ordinary seq-space discipline:
+# a torn stack stages nothing (TruncatedFrame ends the connection), a
+# CRC-corrupt stack is REJECTed whole and retransmitted whole, a
+# duplicate stack (seq + K <= expected) is dropped and re-acked, and a
+# stack STRADDLING the expected position (seq <= expected < seq + K —
+# the mid-frame checkpoint-resume case) is admitted with its already-
+# durable prefix payloads dropped. Each payload's kind byte marks it
+# raw (DATA semantics) or pre-compressed (DATA_COMPRESSED semantics).
+STACKED = 14
 
 FRAME_TYPES = (HELLO, WELCOME, DATA, ACK, REJECT, PAUSE, RESUME, BYE,
-               DATA_COMPRESSED, STATS, NACK, AUTH_CHALLENGE, AUTH_FAIL)
+               DATA_COMPRESSED, STATS, NACK, AUTH_CHALLENGE, AUTH_FAIL,
+               STACKED)
 
 # Bound on a single payload (64 MiB): a length prefix beyond it is
 # treated as a corrupt header, not an allocation request.
@@ -247,6 +266,88 @@ def unpack_payload(buf: bytes) -> dict:
     if pos != len(view):
         raise FrameError(
             f"{len(view) - pos} trailing bytes after the last array"
+        )
+    return out
+
+
+# --------------------------------------------------------------------- #
+# stacked-frame body codec: K (kind, payload-bytes) entries <-> bytes
+
+_STACK_HEAD = struct.Struct(">H")
+_STACK_ENTRY = struct.Struct(">BI")
+
+# Payload kind bytes in the stack's per-payload table.
+STACK_RAW = 0         # DATA semantics (raw-edge payload)
+STACK_COMPRESSED = 1  # DATA_COMPRESSED semantics (codec payload)
+
+# Bound on payloads per stack: a u16 count field, and a frame is
+# bounded by MAX_PAYLOAD anyway — a count beyond this is a malformed
+# sender, not an allocation request.
+MAX_STACK = (1 << 16) - 1
+
+
+def pack_stacked(parts) -> bytes:
+    """Serialize a STACKED frame body from ``[(payload_bytes,
+    compressed), ...]`` — each element an already-``pack_payload``-ed
+    blob plus its kind flag. The caller wraps the result in
+    ``pack_frame(STACKED, base_seq, body)``: the frame-level CRC is the
+    ONLY integrity check for the whole stack (no per-payload CRCs —
+    that is the point)."""
+    n = len(parts)
+    if not 1 <= n <= MAX_STACK:
+        raise FrameError(f"stack of {n} payloads (must be 1..{MAX_STACK})")
+    out = [_STACK_HEAD.pack(n)]
+    blobs = []
+    for blob, compressed in parts:
+        out.append(_STACK_ENTRY.pack(
+            STACK_COMPRESSED if compressed else STACK_RAW, len(blob)
+        ))
+        blobs.append(blob)
+    out.extend(blobs)
+    body = b"".join(out)
+    if len(body) > MAX_PAYLOAD:
+        raise FrameError(
+            f"stacked body of {len(body)} bytes exceeds MAX_PAYLOAD "
+            f"({MAX_PAYLOAD}) — lower stack= / stack_bytes="
+        )
+    return body
+
+
+def unpack_stacked(buf) -> list:
+    """Inverse of :func:`pack_stacked`: returns ``[(payload_bytes,
+    compressed), ...]``. :class:`FrameError` on any structural
+    inconsistency (the frame CRC already vouched for the bytes — this
+    guards against a malformed sender). The per-payload blobs still
+    need :func:`unpack_payload`."""
+    view = memoryview(buf)
+    if len(view) < _STACK_HEAD.size:
+        raise FrameError("stacked body shorter than its count field")
+    (n,) = _STACK_HEAD.unpack(view[:_STACK_HEAD.size])
+    if n < 1:
+        raise FrameError("stacked frame with zero payloads")
+    pos = _STACK_HEAD.size
+    table = []
+    for _ in range(n):
+        if pos + _STACK_ENTRY.size > len(view):
+            raise FrameError("stacked table shorter than its count")
+        kind, length = _STACK_ENTRY.unpack(view[pos:pos + _STACK_ENTRY.size])
+        if kind not in (STACK_RAW, STACK_COMPRESSED):
+            raise FrameError(f"unknown stack payload kind {kind}")
+        table.append((kind, length))
+        pos += _STACK_ENTRY.size
+    out = []
+    for kind, length in table:
+        if pos + length > len(view):
+            raise FrameError(
+                "stacked payload table overruns the frame body"
+            )
+        out.append((bytes(view[pos:pos + length]),
+                    kind == STACK_COMPRESSED))
+        pos += length
+    if pos != len(view):
+        raise FrameError(
+            f"{len(view) - pos} trailing bytes after the last stacked "
+            "payload"
         )
     return out
 

@@ -75,6 +75,18 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``ingest.frames_shed``                DATA frames dropped on arrival
                                       because their tenant's stream is
                                       shed (never staged, never acked)
+``ingest.frames_stacked``             STACKED frames admitted (K
+                                      payloads behind one header/CRC,
+                                      staged as ONE unit)
+``ingest.stack_flush_size``           client stack flushes fired by the
+                                      count ceiling (buffer hit
+                                      ``stack=K``)
+``ingest.stack_flush_bytes``          client stack flushes fired by the
+                                      byte ceiling (``stack_bytes=``)
+``ingest.stack_flush_age``            client stack flushes fired by the
+                                      age deadline (``stack_ms=``);
+                                      tail drains on flush()/close()
+                                      are untagged
 ``engine.units_folded``               pipeline units retired by a fold
 ``engine.chunks_folded``              chunks inside those units
 ``engine.edges_folded``               valid edges (tracer-enabled runs)
@@ -184,6 +196,11 @@ only when a tracer is installed or :func:`recording` is on):
                                       :func:`publish_checkpoint`
 ``ingest.receive_to_stage_ms``        wire frame fully received →
                                       staged for the consumer
+``ingest.chunks_per_stacked_frame``   payload COUNT (not ms) carried by
+                                      each admitted STACKED frame — the
+                                      realized coalescing factor K
+                                      (flush-policy tails drag it below
+                                      the configured ``stack=``)
 ``tenants.round_ms``                  one multi-tenant scheduling
                                       round's batched fold dispatch
 ``multiquery.emit_ms``                fused emission snapshot

@@ -12,7 +12,15 @@ state carries chunk/edge counters (union is idempotent, counters are
 not), so the parent's exactly-once assertion is sharp.
 
 argv: <ckpt_dir> <port_file> <out_npz> <total_chunks> [chunk_sleep_s]
-     [mode: raw|compressed]
+     [mode: raw|compressed] [framing: plain|stacked]
+
+``framing=stacked`` asserts the client really coalesced (the server
+counted STACKED frames) — the parent drives a ``stack=3`` client
+against ``CKPT_EVERY=4``, so checkpoint positions land MID-frame and
+the restart exercises the covering-frame redelivery + durable-prefix
+drop seam. ``frames()`` unstacks transparently, so the fold loop and
+its position assertions are IDENTICAL in both framings: that is the
+point — stacking must be invisible to exactly-once.
 
 ``mode=compressed`` consumes CLIENT-COMPRESSED ``DATA_COMPRESSED``
 frames instead (the parent sends sparse CC (v, root) pairs via
@@ -82,6 +90,7 @@ def main(argv):
     total = int(argv[3])
     sleep_s = float(argv[4]) if len(argv) > 4 else 0.0
     compressed = len(argv) > 5 and argv[5] == "compressed"
+    stacked = len(argv) > 6 and argv[6] == "stacked"
 
     from gelly_tpu.engine.checkpoint import save_checkpoint
     from gelly_tpu.engine.resilience import CheckpointManager
@@ -121,6 +130,17 @@ def main(argv):
                 break
         mgr.save(state, pos)
         srv.ack(pos)
+        if stacked:
+            # Prove the stacked path was really on the wire (a client
+            # that silently degraded to per-chunk frames would make
+            # this run vacuous).
+            from gelly_tpu.obs import bus as obs_bus
+
+            assert obs_bus.get_bus().counters.get(
+                "ingest.frames_stacked", 0) > 0, (
+                "framing=stacked but the server staged no STACKED "
+                "frames"
+            )
     finally:
         srv.stop()
     save_checkpoint(out_path, state, position=pos)

@@ -17,7 +17,14 @@ bit-identity assertion is sharp: one duplicated or dropped chunk
 doubles or loses counts.
 
 argv: <ckpt_dir> <port_file> <out_npz> <total_chunks_per_tenant>
+     [framing: plain|stacked]
 Env: GELLY_QOS_TENANTS / _NV / _CHUNK override the shape.
+
+``framing=stacked`` asserts the server really staged STACKED frames —
+the parent drives a coalescing (``stack=3``) client, so whole
+single-tenant stacks ride the TenantRouter as one unit each and the
+checkpoint-gated acks land at frame granularity; the engine pipeline
+is otherwise IDENTICAL, which is the point.
 """
 
 import os
@@ -37,6 +44,7 @@ CHUNK = int(os.environ.get("GELLY_QOS_CHUNK", "16"))
 def main(argv):
     ckpt_dir, port_file, out_path = argv[0], argv[1], argv[2]
     total = int(argv[3])
+    stacked = len(argv) > 4 and argv[4] == "stacked"
 
     from gelly_tpu.engine.checkpoint import save_checkpoint
     from gelly_tpu.engine.tenants import MultiTenantEngine
@@ -86,6 +94,17 @@ def main(argv):
         time.sleep(0.5)
         rows = [np.asarray(eng.degree(t)) for t in range(TENANTS)]
         positions = [eng.position(t) for t in range(TENANTS)]
+        if stacked:
+            # Prove the stacked path was really on the wire (a client
+            # that silently degraded to per-chunk frames would make
+            # this run vacuous).
+            from gelly_tpu.obs import bus as obs_bus
+
+            assert obs_bus.get_bus().counters.get(
+                "ingest.frames_stacked", 0) > 0, (
+                "framing=stacked but the server staged no STACKED "
+                "frames"
+            )
     finally:
         srv.stop()
         router.stop()

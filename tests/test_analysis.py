@@ -152,6 +152,7 @@ def test_abi_c_parser_handles_comments_strings_and_bodies(tmp_path):
     assert names["byte_fn"].params == ["u8"]
 
 
+@pytest.mark.slow  # tier-1 budget: CI heavy lane; abi tip-clean stays in tier
 def test_abi_cli_exit_codes(abi_fixture, tmp_path):
     native, py = abi_fixture
     clean = subprocess.run(

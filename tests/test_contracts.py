@@ -810,6 +810,7 @@ def test_cli_json_format_covers_contracts(tmp_path, capsys):
     assert {"path", "line", "rule", "message", "hint"} <= set(f0)
 
 
+@pytest.mark.slow  # tier-1 budget: contracts lane; subcommand smoke stays
 def test_cli_all_includes_contracts(capsys):
     rc = analysis_main(["--all", "--root", REPO, "--format=json"])
     payload = json.loads(capsys.readouterr().out)
@@ -817,6 +818,7 @@ def test_cli_all_includes_contracts(capsys):
     assert "contracts" in payload["tools"]
 
 
+@pytest.mark.slow  # tier-1 budget: contracts lane; subcommand smoke stays
 def test_cli_skip_contracts(capsys):
     rc = analysis_main(["--all", "--root", REPO, "--skip-contracts",
                         "--format=json"])

@@ -606,6 +606,329 @@ def test_precompressed_validation():
 
 
 # --------------------------------------------------------------------- #
+# STACKED frames: K chunks behind one header/CRC/syscall/fold-dispatch
+
+
+def test_stacked_body_codec_roundtrip_and_bounds():
+    blobs = [pack_payload(edge_payload([i], [i + 1])) for i in range(5)]
+    parts = [(b, i % 2 == 0) for i, b in enumerate(blobs)]
+    body = wire.pack_stacked(parts)
+    out = wire.unpack_stacked(body)
+    assert out == parts
+    with pytest.raises(wire.FrameError, match="must be 1"):
+        wire.pack_stacked([])
+    with pytest.raises(wire.FrameError):
+        wire.unpack_stacked(body[:-3])  # truncated blob region
+    with pytest.raises(wire.FrameError):
+        wire.unpack_stacked(body + b"x")  # trailing junk
+    bad_kind = bytearray(body)
+    bad_kind[2] = 7  # first table entry's kind byte
+    with pytest.raises(wire.FrameError, match="kind"):
+        wire.unpack_stacked(bytes(bad_kind))
+
+
+def test_stacked_loopback_in_order_and_tail_drain():
+    """stack=K coalesces K sends into one STACKED frame; flush() drains
+    the partial tail (the LV203 contract); positions tile the seq space
+    exactly as the unstacked wire would have numbered them."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            units: list = []
+
+            def run():
+                for item in srv.stacks():
+                    units.append(item)
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            with IngestClient("127.0.0.1", srv.port, stack=4) as cli:
+                for i in range(10):
+                    cli.send(edge_payload([i], [i + 1]))
+                cli.flush(timeout=10)
+                assert cli.acked == 10
+                assert cli.unacked_count == 0
+        t.join(timeout=5)
+        assert [(s, len(p)) for s, p, _ in units] == [(0, 4), (4, 4),
+                                                      (8, 2)]
+        flat = [p for _, ps, _ in units for p in ps]
+        assert [p["src"].tolist() for p in flat] == [[i] for i in
+                                                     range(10)]
+        snap = bus.snapshot()["counters"]
+        assert snap["ingest.frames_stacked"] == 3
+        assert snap["ingest.chunks_enqueued"] == 10
+        assert snap["ingest.stack_flush_size"] == 2  # tail is untagged
+        # 3 frames moved 10 chunks: framing overhead amortized
+        # (HELLO + 3 stacked DATA + BYE = 5 frames on the wire).
+        assert snap["ingest.frames_received"] <= 5
+
+
+def test_stacked_age_deadline_flushes_partial_stack():
+    """The background age thread ships a lingering partial stack
+    without any further send() or flush() call."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port, stack=64,
+                              stack_ms=30) as cli:
+                cli.send(edge_payload([0], [1]))
+                cli.send(edge_payload([1], [2]))
+                deadline = time.monotonic() + 5
+                while len(got) < 2 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1]
+        assert bus.snapshot()["counters"]["ingest.stack_flush_age"] >= 1
+
+
+def test_stacked_byte_ceiling_flushes_before_k():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port, stack=1000,
+                              stack_bytes=1) as cli:
+                # Every payload exceeds the 1-byte ceiling on arrival:
+                # each send flushes immediately (K=1 → legacy frame).
+                cli.send(edge_payload([0], [1]))
+                cli.send(edge_payload([1], [2]))
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1]
+        assert bus.snapshot()["counters"]["ingest.stack_flush_bytes"] == 2
+
+
+def _stacked_frame(base, payloads, compressed=False):
+    parts = [(pack_payload(p), compressed) for p in payloads]
+    return pack_frame(wire.STACKED, base, wire.pack_stacked(parts))
+
+
+def test_corrupt_stacked_frame_rejected_then_whole_frame_lands():
+    """A CRC-corrupt STACKED frame: REJECT + counted, expected seq
+    pinned, and the retransmitted WHOLE frame then stages all K
+    chunks — frame-granularity retransmit, chunk-granularity state."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            frame = _stacked_frame(0, [edge_payload([i], [i])
+                                       for i in range(3)])
+            bad = bytearray(frame)
+            bad[-1] ^= 0xFF
+            with cli._send_lock:
+                cli._sock.sendall(bytes(bad))
+            deadline = time.monotonic() + 5
+            while (bus.snapshot()["counters"].get(
+                    "ingest.frames_rejected", 0) < 1
+                    and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.next_seq == 0  # pinned below the bad bytes
+            with cli._send_lock:
+                cli._sock.sendall(frame)
+            deadline = time.monotonic() + 5
+            while len(got) < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            cli.close()
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1, 2]
+        snap = bus.snapshot()["counters"]
+        assert snap["ingest.frames_rejected"] >= 1
+        assert snap["ingest.frames_stacked"] == 1
+
+
+def test_torn_stacked_frame_stages_nothing():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            frame = _stacked_frame(0, [edge_payload([i], [i])
+                                       for i in range(4)])
+            raw = socket.create_connection(("127.0.0.1", srv.port))
+            raw.sendall(frame[: len(frame) - 11])  # torn mid-stack
+            raw.close()
+            deadline = time.monotonic() + 5
+            while (bus.snapshot()["counters"].get(
+                    "ingest.frames_truncated", 0) < 1
+                    and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.next_seq == 0
+            with IngestClient("127.0.0.1", srv.port, stack=4) as cli:
+                for i in range(4):
+                    cli.send(edge_payload([i], [i]))
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1, 2, 3]
+        assert bus.snapshot()["counters"]["ingest.frames_stacked"] == 1
+
+
+def test_duplicate_stacked_replay_dropped_and_reacked():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port, stack=3).connect()
+            payloads = [edge_payload([i], [i]) for i in range(3)]
+            for p in payloads:
+                cli.send(p)
+            cli.flush(timeout=10)
+            # Replay the whole covering frame raw (a reconnect race):
+            # dropped whole, re-acked at the stream position.
+            with cli._send_lock:
+                cli._sock.sendall(_stacked_frame(0, payloads))
+            cli.send(edge_payload([9], [9]))
+            cli.flush(timeout=10)
+            cli.close()
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1, 2, 3]
+        snap = bus.snapshot()["counters"]
+        assert snap["ingest.frames_duplicate"] == 1
+        assert snap["ingest.frames_stacked"] == 1
+
+
+def test_mixed_stacked_and_unstacked_frames_share_seq_space():
+    """Plain DATA and STACKED frames interleave on one connection and
+    one sequence space — a client may coalesce opportunistically."""
+    with obs_bus.scope():
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            cli.send(edge_payload([0], [0]))          # seq 0, plain
+            with cli._send_lock:                       # [1, 4), stacked
+                cli._sock.sendall(_stacked_frame(
+                    1, [edge_payload([i], [i]) for i in range(1, 4)]))
+            deadline = time.monotonic() + 5
+            while len(got) < 4 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            cli._next[None] = 4  # the raw injection advanced the space
+            cli.send(edge_payload([4], [4]))          # seq 4, plain
+            cli.flush(timeout=10)
+            cli.close()
+        t.join(timeout=5)
+        assert [s for s, _ in got] == [0, 1, 2, 3, 4]
+        assert [p["src"].tolist() for _, p in got] == [[i] for i in
+                                                       range(5)]
+
+
+def test_stacked_mid_frame_resume_drops_durable_prefix():
+    """THE exactly-once seam: a server restarted at a checkpoint
+    position INSIDE a stacked frame re-requests the covering frame and
+    stages only the unseen suffix — the durable prefix is dropped, and
+    the ACK covers the whole frame so the client releases it."""
+    with obs_bus.scope():
+        # Restarted incarnation: checkpoint landed at position 2,
+        # mid-frame of the client's [0, 4) stacked frame.
+        with IngestServer(queue_depth=16, resume_seq=2) as srv:
+            units: list = []
+
+            def run():
+                for item in srv.stacks():
+                    units.append(item)
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            raw = socket.create_connection(("127.0.0.1", srv.port))
+            raw.settimeout(5.0)
+            raw.sendall(pack_frame(wire.HELLO, 0))
+            ftype, seq, _p, _ok = wire.read_frame_checked(raw.recv)
+            assert (ftype, seq) == (wire.WELCOME, 2)
+            raw.sendall(_stacked_frame(
+                0, [edge_payload([i], [i]) for i in range(4)]))
+            ftype, seq, _p, _ok = wire.read_frame_checked(raw.recv)
+            assert (ftype, seq) == (wire.ACK, 4)  # whole frame released
+            deadline = time.monotonic() + 5
+            while not units and time.monotonic() < deadline:
+                time.sleep(0.01)
+            raw.close()
+        t.join(timeout=5)
+        assert [(s, len(p)) for s, p, _ in units] == [(2, 2)]
+        flat = [p["src"].tolist() for _, ps, _ in units for p in ps]
+        assert flat == [[2], [3]]  # prefix [0, 2) dropped, never staged
+
+
+def test_stacked_client_rewinds_covering_frame_on_reconnect():
+    """Client side of the mid-frame seam: after a reconnect WELCOME
+    whose expected seq lands inside an unacked stacked frame, the
+    client retransmits the COVERING frame (its resend buffer is
+    frame-granular) and the stream completes exactly-once."""
+    with obs_bus.scope():
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port, stack=4).connect()
+            for i in range(4):
+                cli.send(edge_payload([i], [i]))
+            cli.flush(timeout=10)
+            # Drop the connection without BYE and reconnect: the server
+            # already staged [0, 4), so the WELCOME re-ack covers the
+            # frame; then keep streaming stacked.
+            cli._teardown_socket()
+            cli.reconnect()
+            for i in range(4, 8):
+                cli.send(edge_payload([i], [i]))
+            cli.flush(timeout=10)
+            cli.close()
+        t.join(timeout=5)
+        assert [s for s, _ in got] == list(range(8))
+
+
+def test_stacked_fold_bit_identical_and_one_dispatch_per_frame():
+    """Acceptance twin: a stacked compressed wire stream folds
+    bit-identically to the unstacked file-ingest path, AND the engine
+    dispatches exactly ONE fold per wire frame (the staged unit rides
+    ``fold_codec``'s stacked dispatch whole)."""
+    from gelly_tpu import obs
+    from gelly_tpu.engine.aggregation import run_aggregation
+    from gelly_tpu.library.connected_components import (
+        connected_components,
+    )
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    n_v = 1 << 10
+    m1 = mesh_lib.make_mesh(1)
+    chunks = _cc_chunks(n_v=n_v, chunk=256, chunks=6)
+    agg_file = connected_components(n_v, codec="sparse")
+    golden = [
+        np.asarray(w) for w in run_aggregation(
+            agg_file, chunks, merge_every=6, mesh=m1, ingest_workers=0,
+            prefetch_depth=0, h2d_depth=0,
+        )
+    ]
+
+    agg_wire = connected_components(n_v, codec="sparse")
+    payloads = [agg_wire.host_compress(c) for c in chunks]
+    tracer = obs.SpanTracer()
+    with obs_bus.scope() as bus, obs.install(tracer):
+        with IngestServer(queue_depth=16, stop_on_bye=True) as srv:
+            def feed():
+                with IngestClient("127.0.0.1", srv.port,
+                                  stack=3) as cli:
+                    for p in payloads:
+                        cli.send_compressed(p)
+                    cli.flush(timeout=30)
+            t = threading.Thread(target=feed, daemon=True)
+            t.start()
+            wire_windows = [
+                np.asarray(w) for w in run_aggregation(
+                    agg_wire, srv.compressed_payload_units(),
+                    merge_every=6, fold_batch=3, mesh=m1,
+                    precompressed=True, ingest_workers=0,
+                    prefetch_depth=0, h2d_depth=0,
+                )
+            ]
+            t.join(timeout=30)
+        snap = bus.snapshot()["counters"]
+    assert len(wire_windows) == len(golden) >= 1
+    for i, (w, g) in enumerate(zip(wire_windows, golden)):
+        assert w.tobytes() == g.tobytes(), f"window {i} diverged"
+    # ONE fold dispatch per wire frame: 6 chunks in 2 stacked frames.
+    assert snap["ingest.frames_stacked"] == 2
+    assert snap["engine.units_folded"] == 2
+    assert snap["engine.chunks_folded"] == 6
+    assert len(tracer.spans("fold")) == 2
+    assert tracer.spans("compress") == []  # producer-compressed
+
+
+# --------------------------------------------------------------------- #
 # SIGKILL'd server: no double-fold of acked chunks (slow; CI ingest lane)
 
 
@@ -614,12 +937,12 @@ CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _spawn_server_child(ckpt, port_file, out, total, sleep_s,
-                        mode="raw"):
+                        mode="raw", framing="plain"):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
     return subprocess.Popen(
         [sys.executable, CHILD, str(ckpt), str(port_file), str(out),
-         str(total), str(sleep_s), mode],
+         str(total), str(sleep_s), mode, framing],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
 
@@ -640,15 +963,25 @@ def _wait_port(port_file, proc, timeout=120):
 
 @pytest.mark.slow
 @pytest.mark.faults
-@pytest.mark.parametrize("mode", ["raw", "compressed"])
-def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path, mode):
+@pytest.mark.parametrize("mode,stack", [
+    ("raw", 1), ("compressed", 1), ("raw", 3), ("compressed", 3),
+])
+def test_sigkilled_server_never_double_folds_acked_chunks(
+        tmp_path, mode, stack):
     """``mode="compressed"`` runs the same SIGKILL protocol over
     CLIENT-COMPRESSED DATA_COMPRESSED frames (sparse CC pairs): acked
     compressed chunks must never double-fold either — same seq space,
-    same checkpoint-gated ack contract."""
+    same checkpoint-gated ack contract.
+
+    ``stack=3`` reruns the matrix with a coalescing client: 3 is
+    coprime with the child's ``CKPT_EVERY=4``, so durable checkpoint
+    positions land MID-frame and the kill/restart exercises the
+    covering-frame redelivery + durable-prefix-drop seam — stacking
+    must be invisible to exactly-once."""
     import _ingest_crash_child as child_mod
 
     compressed = mode == "compressed"
+    framing = "stacked" if stack > 1 else "plain"
     rng = np.random.default_rng(23)
     total = 64
 
@@ -674,9 +1007,11 @@ def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path, mode):
     port_file = str(tmp_path / "port")
     out = str(tmp_path / "final.npz")
 
-    p1 = _spawn_server_child(ckpt, port_file, out, total, 0.03, mode)
+    p1 = _spawn_server_child(ckpt, port_file, out, total, 0.03, mode,
+                             framing)
     port = _wait_port(port_file, p1)
-    cli = IngestClient("127.0.0.1", port, send_pause_timeout=60)
+    cli = IngestClient("127.0.0.1", port, send_pause_timeout=60,
+                       stack=stack)
     cli.connect()
 
     sent = 0
@@ -718,7 +1053,8 @@ def test_sigkilled_server_never_double_folds_acked_chunks(tmp_path, mode):
     # valid checkpoint; the client reconnects and resends exactly the
     # unacked suffix.
     os.unlink(port_file)
-    p2 = _spawn_server_child(ckpt, port_file, out, total, 0.0, mode)
+    p2 = _spawn_server_child(ckpt, port_file, out, total, 0.0, mode,
+                             framing)
     cli.port = _wait_port(port_file, p2)
     deadline = time.monotonic() + 60
     while True:

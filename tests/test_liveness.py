@@ -859,6 +859,7 @@ def test_cli_liveness_subcommand_exit_zero_on_tip(capsys):
     assert "analysis clean (liveness)" in out
 
 
+@pytest.mark.slow  # tier-1 budget: liveness lane; subcommand smoke stays
 def test_cli_skip_liveness(capsys):
     rc = analysis_main(["--all", "--root", REPO, "--skip-liveness",
                         "--format=json"])

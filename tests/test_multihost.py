@@ -346,6 +346,7 @@ _CC4_WORKER = _PREAMBLE + textwrap.dedent(
 )
 
 
+@pytest.mark.slow  # tier-1 budget: two-process twin stays in tier
 def test_multihost_cc_merge_four_processes_hierarchical(tmp_path):
     """The 4-process x 2-device tier (VERDICT r4 item 6): butterfly AND
     degree-grouped hierarchical merges across FOUR process groups produce
@@ -355,6 +356,7 @@ def test_multihost_cc_merge_four_processes_hierarchical(tmp_path):
     _run_procs(_CC4_WORKER, "MULTIHOST_CC4_OK", nprocs=4, devs_per_proc=2)
 
 
+@pytest.mark.slow  # tier-1 budget: two-process twin stays in tier
 def test_multihost_keyed_exchange_four_processes(tmp_path):
     """repartition_by_key across 8 shards on 4 processes: every entry
     lands on its striped owner, multiset conserved, zero drops."""

@@ -747,6 +747,7 @@ def test_cli_list_rules_includes_pc_rules_and_src(capsys):
         assert rid in out, rid
 
 
+@pytest.mark.slow  # tier-1 budget: plancheck lane; subcommand smoke stays
 def test_cli_skip_plancheck(capsys):
     rc = analysis_main(["--all", "--root", REPO, "--skip-plancheck",
                         "--format=json"])

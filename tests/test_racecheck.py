@@ -408,6 +408,7 @@ def test_cli_racecheck_subcommand_exit_zero_on_tip(capsys):
     assert "analysis clean (racecheck)" in out
 
 
+@pytest.mark.slow  # tier-1 budget: racecheck lane; subcommand smoke stays
 def test_cli_all_prints_per_tool_summary(capsys):
     rc = analysis_main(["--all", "--root", REPO])
     out = capsys.readouterr().out
@@ -428,6 +429,7 @@ def test_cli_nonzero_and_counts_on_findings(tmp_path, capsys):
     assert "racecheck: 7 finding(s)" in captured.err
 
 
+@pytest.mark.slow  # tier-1 budget: racecheck lane; subcommand smoke stays
 def test_cli_json_format(tmp_path, capsys):
     (tmp_path / "racy.py").write_text(RACY_SRC)
     rc = analysis_main(["racecheck", str(tmp_path), "--root", REPO,

@@ -30,6 +30,7 @@ def _rand(n_e, seed):
             rng.integers(0, N_V, n_e).astype(np.int64))
 
 
+@pytest.mark.slow  # tier-1 budget: runs in the CI heavy lane
 @pytest.mark.parametrize("seed", [1, 2])
 def test_sharded_exact_parity_random(seed):
     src, dst = _rand(1500, seed)

@@ -91,6 +91,7 @@ def test_spanner_overflow_flag():
         spanner_edges(summary, s.ctx)
 
 
+@pytest.mark.slow  # tier-1 budget: CI heavy lane
 def test_sparse_spanner_matches_dense_when_unconstrained():
     # With generous degree/frontier caps the sparse gate sees the same
     # reachability as the dense one => identical accepted edge lists.
@@ -288,6 +289,7 @@ def test_spanner_ingest_codec_multichunk_stretch(sparse):
         assert bfs_dist(adj, a, b) <= k * k, (a, b)
 
 
+@pytest.mark.slow  # tier-1 budget: the dedups/scan-gate twin stays in tier
 def test_batched_gate_k2_properties_and_pruning():
     """The gate_batch fold (closed-form distance-2 gate, VERDICT r4
     item 9) must uphold every spanner property — subset, stretch <= 2,

@@ -3,6 +3,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from gelly_tpu.ops.unionfind import (
     component_labels,
@@ -218,6 +219,7 @@ def test_union_pairs_star_deep_chain_no_severed_edges():
     assert len({lab[x] for x in (3, 16, 17, 18, 19, 20)}) == 1, lab
 
 
+@pytest.mark.slow  # tier-1 budget: deep-chain twin stays in tier
 def test_union_pairs_star_sequential_calls_fuzz():
     # Regression for the severed-edge bug (code-review r4): unrolled fast
     # rounds hooking at a depth-limited NON-root overwrote its real parent
