@@ -222,6 +222,8 @@ REFUSAL_MATRIX: dict[tuple[str, str], dict[str, frozenset]] = {
             frozenset({"MultiQueryPlan"}),
         "codec-only sub-query without the shared codec":
             frozenset({"requires_codec"}),
+        "windowed pane-ring sub-plan (single-stream ring)":
+            frozenset({"windowed_panes"}),
     },
     ("aggregation.py", "run_aggregation"): {
         "source_provider x window_ms":
@@ -246,6 +248,24 @@ REFUSAL_MATRIX: dict[tuple[str, str], dict[str, frozenset]] = {
             frozenset({"fused", "host_precombine"}),
         "fused plan x mesh with a non-accumulating query":
             frozenset({"fused", "accum"}),
+        "windowed x window_ms":
+            frozenset({"windowed", "window_ms"}),
+        "windowed x fused plan":
+            frozenset({"windowed", "fused"}),
+        "windowed x transient":
+            frozenset({"windowed", "transient"}),
+        "windowed x source_provider":
+            frozenset({"windowed", "source_provider"}),
+        "windowed x precompressed":
+            frozenset({"windowed", "precompressed"}),
+        "windowed x dirty-delta merge":
+            frozenset({"windowed", "merge_delta"}),
+        "ttl without a windowed pane ring":
+            frozenset({"ttl_panes", "windowed"}),
+        "ttl without the eviction hooks":
+            frozenset({"ttl_panes", "windowed_evict"}),
+        "ttl x pipeline lookahead":
+            frozenset({"ttl_panes", "prefetch_depth"}),
     },
     ("aggregation.py", "_compiled_tenant_plan"): {
         "stack_ordered codec (global-order id session)":
@@ -254,6 +274,8 @@ REFUSAL_MATRIX: dict[tuple[str, str], dict[str, frozenset]] = {
             frozenset({"requires_codec", "fold_compressed"}),
         "host-side transform (jit_transform=False)":
             frozenset({"jit_transform"}),
+        "windowed pane-ring plan in a tenant tier":
+            frozenset({"windowed_panes"}),
     },
     ("aggregation.py", "_compiled_plan"): {
         "unknown merge_mode": frozenset({"merge_mode"}),

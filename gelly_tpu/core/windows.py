@@ -205,3 +205,123 @@ def _tumbling_with_lateness(
         if pending:
             yield from flush(closed_upto)
     yield from flush(None)
+
+
+class PaneRing:
+    """Two-stack suffix aggregation over the last ``window_panes`` pane
+    summaries (the FOO/DABA shape): a sliding window of W panes answered
+    in O(1) amortized ``combine`` dispatches per pane close.
+
+    The temporal engine folds edges into the CURRENT pane with the
+    ordinary compiled fold; at each pane boundary the closed pane summary
+    is :meth:`push`-ed here and :meth:`query` returns the combine of the
+    last ``min(live, W)`` panes — never a W-pane re-merge and never a
+    replay. Structure:
+
+    - ``_back`` — raw panes in arrival order, with ``_back_agg`` the
+      running combine of all of them (one combine per push);
+    - ``_front`` — ``(raw_pane, suffix_agg)`` pairs where each entry's
+      ``suffix_agg`` is the combine of that pane and every YOUNGER front
+      pane, so evicting the oldest pane is a stack pop;
+    - when the front empties, the back flips into it (one combine per
+      moved pane — each pane is moved at most once, hence O(1)
+      amortized; ``combines`` counts every dispatch so tests/bench can
+      assert the amortization contract).
+
+    ``combine`` must be associative with ``init``-shaped identities (the
+    plan's ``SummaryAggregation.combine``, pre-jitted by the engine).
+    Raw panes are kept on BOTH stacks — they are the checkpoint payload
+    (:meth:`export_panes`) and the rebuild source after a TTL
+    permutation (:meth:`reload`).
+    """
+
+    def __init__(self, window_panes: int, combine, on_combine=None):
+        if window_panes < 1:
+            raise ValueError(
+                f"window_panes must be >= 1, got {window_panes}")
+        self.window_panes = int(window_panes)
+        self._combine = combine
+        self._on_combine = on_combine  # optional hook: called per dispatch
+        self._front: list = []   # (raw pane, suffix agg), oldest last
+        self._back: list = []    # raw panes, oldest first
+        self._back_agg = None
+        self.panes_closed = 0    # total panes ever pushed
+        self.combines = 0        # total combine dispatches ever issued
+
+    # ------------------------------------------------------------- internals
+
+    def _comb(self, a, b):
+        self.combines += 1
+        if self._on_combine is not None:
+            self._on_combine(1)
+        return self._combine(a, b)
+
+    def _flip(self):
+        # Move the back panes into the front stack with precomputed
+        # suffix aggregates: iterate youngest -> oldest so each entry's
+        # agg covers itself plus every younger pane. One combine per
+        # moved pane; each pane flips at most once in its lifetime.
+        agg = None
+        for pane in reversed(self._back):
+            agg = pane if agg is None else self._comb(pane, agg)
+            self._front.append((pane, agg))
+        self._back = []
+        self._back_agg = None
+
+    # ------------------------------------------------------------------- api
+
+    @property
+    def live(self) -> int:
+        """Panes currently inside the window (<= window_panes)."""
+        return len(self._front) + len(self._back)
+
+    def push(self, pane) -> None:
+        """Close a pane into the ring; evicts the oldest pane once the
+        ring holds ``window_panes``. O(1) amortized combines."""
+        if self.live >= self.window_panes:
+            if not self._front:
+                self._flip()
+            self._front.pop()
+        self._back.append(pane)
+        self._back_agg = (
+            pane if self._back_agg is None
+            else self._comb(self._back_agg, pane)
+        )
+        self.panes_closed += 1
+
+    def query(self):
+        """Combine of every live pane (None when empty): at most ONE
+        combine dispatch on top of the maintained stack aggregates."""
+        front_agg = self._front[-1][1] if self._front else None
+        if front_agg is None:
+            return self._back_agg
+        if self._back_agg is None:
+            return front_agg
+        return self._comb(front_agg, self._back_agg)
+
+    def export_panes(self) -> list:
+        """Raw live pane summaries, oldest -> newest — the checkpoint
+        payload (stack aggregates are derived state and are NOT
+        exported; :meth:`reload` rebuilds them deterministically)."""
+        return [p for p, _ in reversed(self._front)] + list(self._back)
+
+    def reload(self, panes: list, panes_closed: int) -> None:
+        """Rebuild from raw panes (oldest -> newest), e.g. checkpoint
+        resume or a TTL compaction remap. Stack aggregates rebuild
+        canonically with all panes on the back — every summary combine
+        in this engine is an associative integer merge (min-label
+        forests / counter adds), so the regrouping is emission-
+        invariant; the next eviction simply pays one flip."""
+        if len(panes) > self.window_panes:
+            raise ValueError(
+                f"{len(panes)} panes exceed the {self.window_panes}-pane "
+                "window")
+        self._front = []
+        self._back = list(panes)
+        self._back_agg = None
+        for pane in self._back:
+            self._back_agg = (
+                pane if self._back_agg is None
+                else self._comb(self._back_agg, pane)
+            )
+        self.panes_closed = int(panes_closed)

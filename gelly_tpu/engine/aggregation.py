@@ -447,6 +447,27 @@ class SummaryStream:
         return last
 
 
+class WindowedStream(SummaryStream):
+    """A :class:`SummaryStream` over a pane ring (``windowed=W``), plus
+    the queryable epoch handle: ``snapshot()`` returns the latest
+    ``{"window", "labels"}`` emission under a lock, readable from any
+    thread while the stream advances. Staleness is bounded by ONE pane
+    (the value published at the most recent pane close) — the same
+    contract as tenant/multiquery snapshots. Returns ``None`` before
+    the first pane closes.
+    """
+
+    def __init__(self, gen_fn: Callable[[], Iterator], holder: dict):
+        super().__init__(gen_fn)
+        self._holder = holder
+
+    def snapshot(self):
+        with self._holder["lock"]:
+            val = self._holder["val"]
+        obs_bus.get_bus().inc("windows.snapshot_reads")
+        return val
+
+
 def _compiled_plan(agg: SummaryAggregation, m):
     # Jitted physical plans are memoized on the aggregation instance itself:
     # jax.jit caches executables by function identity, so rebuilding the
@@ -759,6 +780,16 @@ def _compiled_tenant_plan(agg: SummaryAggregation, lanes: int,
     """
     if lanes < 1:
         raise ValueError(f"lanes must be >= 1, got {lanes}")
+    windowed_panes = getattr(agg, "windowed_panes", None)
+    if windowed_panes is not None:
+        raise ValueError(
+            f"aggregation '{agg.name}' carries a pane ring "
+            f"(windowed_panes={windowed_panes}): the ring's two-stack "
+            "state and TTL session rebuilds are single-stream host "
+            "structures the vmapped tenant lanes cannot share — run "
+            "the windowed query as its own stream, or add the "
+            "non-windowed builder variant as the tier plan"
+        )
     if agg.stack_ordered:
         raise ValueError(
             f"aggregation '{agg.name}' uses an ordered stacker "
@@ -886,6 +917,8 @@ def run_aggregation(
     source_provider=None,
     queries=None,
     precompressed: bool = False,
+    windowed: int | None = None,
+    ttl_panes: int | None = None,
 ) -> SummaryStream:
     """Execute ``agg`` over ``stream`` — the TPU ``run()``.
 
@@ -1007,6 +1040,31 @@ def run_aggregation(
     last-retired-chunk checkpoint rule counts payload units exactly
     like chunks, so exactly-once resume composes unchanged.
 
+    **Sliding pane-ring windows** (``windowed=W``): the emission covers
+    only the last W *panes* instead of the whole stream, where one pane
+    is one merge window (``merge_every`` chunks — the pane size knob).
+    Pane summaries live in a ring and the W-pane window is answered by
+    a two-stack suffix aggregation (the FOO/DABA shape), so a pane
+    close costs O(1) amortized ``combine`` dispatches — never a W-pane
+    re-merge and never a replay — and the per-close cost scales with
+    the PANE size, not the window length. ``ttl_panes=T`` (T >= W,
+    compact-id plans only) adds per-vertex decay: each compact-id slot
+    carries a last-seen pane stamp, and slots idle for T panes are
+    evicted through the plan's ``windowed_evict`` hook at a pane
+    boundary (the ``CompactIdSession`` rebuild), so steady-state device
+    capacity is bounded by the ACTIVE vertex set, not the stream
+    history. TTL requires a quiesced pipeline (``prefetch_depth=0,
+    h2d_depth=0``): the session rebuild renumbers compact ids, which is
+    only sound with no staged-but-unfolded assignments in flight. The
+    returned stream is a :class:`WindowedStream`; ``snapshot()`` reads
+    the latest ``{"window", "labels"}`` emission under a lock while the
+    stream advances (one-pane staleness — the same contract as
+    tenant/multiquery snapshots). Checkpoints snapshot the ring, the
+    persistent id map and the TTL stamps under the same single recorded
+    position (pane boundaries are the only checkpoint points in
+    windowed mode), so exactly-once resume covers the ring + pane index
+    bit-identically.
+
     **Exactly-once resume — the last-retired-chunk rule**: the recorded
     checkpoint position counts only chunks whose fold was *dispatched*
     (retired from the pipeline); units still in the compress/H2D double
@@ -1119,6 +1177,100 @@ def run_aggregation(
         # ingest_workers above the default cap gets the matching depth —
         # capping here too would permanently idle the extra workers.
         prefetch_depth = max(2, ingest_workers)
+
+    # Pane-ring eligibility (PC4xx refusal matrix): the knobs a pane
+    # ring composes with are exactly the merge_every pipeline's — every
+    # incompatible axis is refused loudly here, never silently ignored.
+    if windowed is None:
+        windowed = getattr(agg, "windowed_panes", None)
+    if ttl_panes is None:
+        ttl_panes = getattr(agg, "windowed_ttl_panes", None)
+    windowed_evict = getattr(agg, "windowed_evict", None)
+    win_touched = getattr(agg, "windowed_touched", None)
+    win_persist_init = getattr(agg, "windowed_persist_init", None)
+    win_persist_update = getattr(agg, "windowed_persist_update", None)
+    win_query_fixup = getattr(agg, "windowed_query_fixup", None)
+    win_on_resume = getattr(agg, "on_resume_windowed", None)
+    if windowed is not None:
+        windowed = int(windowed)
+        if windowed < 1:
+            raise ValueError(f"windowed must be >= 1 pane, got {windowed}")
+        if window_ms is not None:
+            raise ValueError(
+                "windowed panes ride the merge_every cadence (one pane "
+                "per merge window, merge_every chunks each); event-time "
+                "window_ms is a different cadence axis — size the pane "
+                "with merge_every instead"
+            )
+        if fused:
+            raise ValueError(
+                f"fused plan '{agg.name}' cannot carry a pane ring: the "
+                "ring combines ONE plan's pane summaries, and per-query "
+                "cadences (QuerySpec.every) would desynchronize the "
+                "shared pane boundary — run the windowed query as its "
+                "own stream"
+            )
+        if agg.transient:
+            raise ValueError(
+                f"aggregation '{agg.name}' is transient (emit-and-reset "
+                "Merger): its windows are already independent, so a "
+                "pane ring over them has nothing to combine — drop "
+                "windowed= or use a non-transient plan"
+            )
+        if source_provider is not None:
+            raise ValueError(
+                "windowed panes are single-iterator only: sharded "
+                "reader lanes retire units in provider merge order, "
+                "and the pane boundary (merge_every chunks) must land "
+                "on the exactly-once stream order the ring checkpoints"
+            )
+        if precompressed:
+            raise ValueError(
+                "windowed panes refuse precompressed payload streams "
+                "for now: pre-grouped STACKED units may straddle a "
+                "pane boundary, which would fold one frame into two "
+                "panes — feed raw chunks (the engine compresses "
+                "per-pane)"
+            )
+        if agg.merge_mode == "delta" or agg.merge_delta is not None:
+            raise ValueError(
+                f"aggregation '{agg.name}' supplies a dirty-delta merge "
+                "(merge_mode/merge_delta): the delta path folds dirty "
+                "rows into a CARRIED global summary, but a pane ring "
+                "retires panes — the two memory models are exclusive; "
+                "use the windowed builder variant (merge_delta=None)"
+            )
+    if ttl_panes is not None:
+        ttl_panes = int(ttl_panes)
+        if windowed is None:
+            raise ValueError(
+                "ttl_panes requires windowed=W: TTL stamps are "
+                "last-seen PANE indices, and eviction runs at pane "
+                "boundaries — there is no pane clock without a ring"
+            )
+        if ttl_panes < windowed:
+            raise ValueError(
+                f"ttl_panes={ttl_panes} < windowed={windowed}: a slot "
+                "must outlive the ring (T >= W) so an evicted id is "
+                "guaranteed untouched in every live pane — otherwise "
+                "eviction would rewrite panes that still reference it"
+            )
+        if windowed_evict is None or win_touched is None:
+            raise ValueError(
+                f"aggregation '{agg.name}' has no TTL eviction hooks "
+                "(windowed_evict + windowed_touched): per-vertex decay "
+                "needs a compact-id plan that can renumber its session "
+                "— build one with connected_components(compact=..., "
+                "windowed=W, ttl_panes=T)"
+            )
+        if prefetch_depth != 0 or h2d_depth != 0:
+            raise ValueError(
+                "ttl_panes needs a quiesced pipeline: pass "
+                "prefetch_depth=0 and h2d_depth=0 so no compact-id "
+                "assignment is staged but unfolded when the session "
+                "renumbers at a pane boundary (in-flight payloads "
+                "would still carry the OLD ids)"
+            )
     m = mesh if mesh is not None else mesh_lib.make_mesh()
     S = mesh_lib.num_shards(m)
     if fused:
@@ -1231,9 +1383,20 @@ def run_aggregation(
     stats = {"late_edges": 0, "windows_closed": 0, "chunks": 0,
              "merge_modes": {"delta": 0, "replicated": 0}}
 
+    # Queryable epoch snapshot holder (windowed mode): the latest
+    # {window, labels} emission, readable under a lock while the stream
+    # advances — published at every pane close, so a reader is at most
+    # one pane stale (the tenant/multiquery snapshot contract).
+    win_holder = None
+    if windowed is not None:
+        win_holder = {"lock": threading.Lock(), "val": None}
+
     # The accumulate plan (see SummaryAggregation.fold_accumulates): one
-    # running summary, no per-window Merger combine.
-    accum = agg.fold_accumulates and not agg.transient and S == 1
+    # running summary, no per-window Merger combine. A pane ring opts
+    # out: panes must fold from FRESH locals so each pane summary covers
+    # exactly its own merge window (the ring supplies the accumulation).
+    accum = (agg.fold_accumulates and not agg.transient and S == 1
+             and windowed is None)
 
     def gen():
         if agg.on_run_start is not None:
@@ -1312,6 +1475,44 @@ def run_aggregation(
         windows_closed = 0
         last_ckpt_windows = 0
 
+        # Pane-ring state (windowed=W): a ring of pane summaries
+        # answered by two-stack suffix aggregation (core/windows.py),
+        # plus the compact-plan sidecars — the persistent id map
+        # (superset of every live pane's assignments) and the per-slot
+        # TTL last-seen stamps.
+        ring = None
+        persist_vof = None
+        last_seen = None
+        if windowed is not None:
+            from ..core.windows import PaneRing
+
+            ring = PaneRing(
+                windowed, merger_step,
+                on_combine=lambda n: bus.inc(
+                    "windows.combine_dispatches", n),
+            )
+            if win_persist_init is not None:
+                persist_vof = win_persist_init()
+            if ttl_panes is not None:
+                last_seen = np.zeros(
+                    int(persist_vof.shape[0]), dtype=np.int64
+                )
+
+        def _win_like():
+            # Static checkpoint template: [W, ...] stacked pane leaves
+            # (live panes padded with init panes at save time), so the
+            # on-disk shape never depends on ring occupancy.
+            panes = jax.tree.map(
+                lambda l: jnp.zeros((windowed,) + l.shape, l.dtype),
+                agg.init(),
+            )
+            like = {"panes": panes}
+            if persist_vof is not None:
+                like["persist"] = jnp.zeros_like(persist_vof)
+            if last_seen is not None:
+                like["last_seen"] = jnp.zeros(last_seen.shape, jnp.int64)
+            return like
+
         lat_handle: dict = {}
         lat_state = None
         if resume:
@@ -1319,18 +1520,44 @@ def run_aggregation(
                 raise ValueError("resume=True requires checkpoint_path")
             from .checkpoint import load_checkpoint
 
-            global_summary, skip_until, meta_in = load_checkpoint(
-                checkpoint_path, like=global_summary
-            )
-            global_summary = jax.tree.map(jnp.asarray, global_summary)
-            if agg.on_resume is not None:
-                agg.on_resume(global_summary)
-            current_window = meta_in.get("current_window")
-            windows_closed = last_ckpt_windows = meta_in.get("windows", 0)
-            if accum:
-                # The running summary IS the restored global: folds resume
-                # into it directly.
-                locals_ = global_summary
+            if windowed is not None:
+                snap_in, skip_until, meta_in = load_checkpoint(
+                    checkpoint_path, like=_win_like()
+                )
+                snap_in = jax.tree.map(jnp.asarray, snap_in)
+                live_n = int(meta_in.get("ring_live", 0))
+                panes = [
+                    jax.tree.map(lambda l, i=i: l[i], snap_in["panes"])
+                    for i in range(live_n)
+                ]
+                current_window = meta_in.get("current_window")
+                windows_closed = last_ckpt_windows = meta_in.get(
+                    "windows", 0)
+                ring.reload(panes, windows_closed)
+                if persist_vof is not None:
+                    persist_vof = snap_in["persist"]
+                if last_seen is not None:
+                    last_seen = np.asarray(snap_in["last_seen"]).copy()
+                if win_on_resume is not None:
+                    # Rebuild the compact-id session from the PERSISTENT
+                    # map — a superset of every live pane's assignments —
+                    # never from any single pane (panes only record
+                    # FIRST-seen rows).
+                    win_on_resume(np.asarray(persist_vof))
+            else:
+                global_summary, skip_until, meta_in = load_checkpoint(
+                    checkpoint_path, like=global_summary
+                )
+                global_summary = jax.tree.map(jnp.asarray, global_summary)
+                if agg.on_resume is not None:
+                    agg.on_resume(global_summary)
+                current_window = meta_in.get("current_window")
+                windows_closed = last_ckpt_windows = meta_in.get(
+                    "windows", 0)
+                if accum:
+                    # The running summary IS the restored global: folds
+                    # resume into it directly.
+                    locals_ = global_summary
             if allowed_lateness:
                 import os as _os
 
@@ -1457,6 +1684,82 @@ def run_aggregation(
                                mode=mode)
             return transform_fn(out) if transform_fn else out
 
+        def close_pane():
+            # Pane boundary (windowed mode): capture this merge window's
+            # summary from fresh locals, push it into the ring, decay
+            # TTL slots, and answer the W-pane window by suffix
+            # aggregation — O(1) amortized combine dispatches per close,
+            # never a W-pane re-merge and never a replay.
+            nonlocal locals_, windows_closed, dirty, persist_vof, \
+                last_seen
+            faults_mod.inject("collective")
+            t_h = time.perf_counter() if telemetry else 0.0
+            pane = merge_locals(locals_)
+            # Rebind BEFORE the pane enters the ring: folds donate their
+            # summary argument, so the captured pane buffer must never
+            # be passed to a fold again.
+            locals_ = locals0_fn()
+            dirty = False
+            if win_persist_update is not None:
+                persist_vof = win_persist_update(persist_vof, pane)
+            ring.push(pane)
+            windows_closed += 1
+            stats["windows_closed"] = windows_closed
+            stats["ring_combines"] = ring.combines
+            bus.inc("engine.windows_closed")
+            bus.inc("windows.panes_closed")
+            if last_seen is not None:
+                touched = np.asarray(win_touched(pane))
+                last_seen[touched] = windows_closed
+                assigned = int(agg.session.assigned)
+                stale = np.zeros(last_seen.shape[0], dtype=bool)
+                if assigned:
+                    stale[:assigned] = (
+                        windows_closed - last_seen[:assigned]
+                    ) >= ttl_panes
+                if stale.any():
+                    # T >= W guarantees a stale slot is untouched in
+                    # every live pane, so the hook can renumber the
+                    # survivors to a dense prefix (reclaiming session
+                    # capacity) and remap each pane without losing any
+                    # window-visible state.
+                    n_evict = int(stale.sum())
+                    panes_np = [
+                        jax.tree.map(np.asarray, p)
+                        for p in ring.export_panes()
+                    ]
+                    panes2, persist2, surv = windowed_evict(
+                        panes_np, np.asarray(persist_vof), stale
+                    )
+                    persist_vof = jnp.asarray(persist2)
+                    ls2 = np.zeros_like(last_seen)
+                    ls2[:len(surv)] = last_seen[surv]
+                    last_seen = ls2
+                    ring.reload(
+                        [jax.tree.map(jnp.asarray, p) for p in panes2],
+                        ring.panes_closed,
+                    )
+                    bus.inc("windows.evicted_slots", n_evict)
+                bus.gauge("windows.live_slots", int(agg.session.assigned))
+            q = ring.query()
+            if win_query_fixup is not None:
+                q = win_query_fixup(q, persist_vof)
+            out = transform_fn(q) if transform_fn else q
+            bus.gauge("windows.ring_live", ring.live)
+            if telemetry:
+                bus.observe("windows.pane_close_ms",
+                            (time.perf_counter() - t_h) * 1e3)
+            if tracer is not None:
+                tracer.instant("pane_close", window=windows_closed,
+                               ring_live=ring.live,
+                               combines=ring.combines)
+            with win_holder["lock"]:
+                win_holder["val"] = {"window": windows_closed,
+                                     "labels": out}
+            return out
+
+        close_fn = close_pane if windowed is not None else close_window
+
         def maybe_checkpoint(force=False):
             # Chunk-boundary-only checkpoints: every consumed edge is in
             # global_summary or locals_ — or, with allowed_lateness, in
@@ -1478,12 +1781,31 @@ def run_aggregation(
             # cost is already being paid (the snapshot's device_get).
             # The flattened summary REPLACES the live state — labels
             # are identical by the flatten contract.
-            if flatten_fn is not None:
+            if flatten_fn is not None and windowed is None:
                 if accum:
                     locals_ = flatten_fn(locals_)
                 else:
                     global_summary = flatten_fn(global_summary)
-            if accum:
+            if windowed is not None:
+                # Ring snapshot: live panes stacked onto the STATIC
+                # [W, ...] template (padded with init panes), plus the
+                # persistent id map and TTL stamps — one recorded
+                # position covers the ring AND the pane index, and
+                # windowed checkpoints only ever fire at pane
+                # boundaries (the cadence check above trips right after
+                # a close, before any chunk folds into the next pane).
+                panes = ring.export_panes()
+                pads = [agg.init() for _ in range(windowed - len(panes))]
+                snap = {
+                    "panes": jax.tree.map(
+                        lambda *ls: jnp.stack(ls), *(panes + pads)
+                    )
+                }
+                if persist_vof is not None:
+                    snap["persist"] = persist_vof
+                if last_seen is not None:
+                    snap["last_seen"] = jnp.asarray(last_seen)
+            elif accum:
                 snap = locals_  # the running summary holds every edge
             else:
                 snap = (
@@ -1506,13 +1828,17 @@ def run_aggregation(
                     },
                 )
             t_wall = time.perf_counter()
+            ck_meta = {
+                "name": agg.name,
+                "windows": windows_closed,
+                "current_window": current_window,
+            }
+            if windowed is not None:
+                ck_meta["ring_live"] = ring.live
+                ck_meta["windowed"] = windowed
             save_checkpoint(
                 checkpoint_path, snap, position=chunks_consumed,
-                meta={
-                    "name": agg.name,
-                    "windows": windows_closed,
-                    "current_window": current_window,
-                },
+                meta=ck_meta,
             )
             ck_bytes = obs_bus.publish_checkpoint(bus, "engine",
                                                   checkpoint_path,
@@ -2078,7 +2404,7 @@ def run_aggregation(
                         t_h = (time.perf_counter() if telemetry
                                else 0.0)
                         with timer("merge_emit"):
-                            out = close_window()
+                            out = close_fn()
                             # The window's ONE completion barrier: the
                             # emission (and with it every fold of the
                             # window) is ready before it is yielded.
@@ -2097,7 +2423,7 @@ def run_aggregation(
                     t_merge = tracer.now() if tracer is not None else 0.0
                     t_h = time.perf_counter() if telemetry else 0.0
                     with timer("merge_emit"):
-                        out = close_window()
+                        out = close_fn()
                         jax.block_until_ready(out)
                     if telemetry:
                         bus.observe("engine.merge_emit_ms",
@@ -2150,7 +2476,10 @@ def run_aggregation(
                 # holding the timer object.
                 timer.publish(bus)
 
-    out_stream = SummaryStream(gen)
+    if windowed is not None:
+        out_stream = WindowedStream(gen, win_holder)
+    else:
+        out_stream = SummaryStream(gen)
     out_stream.stats = stats
     out_stream.timer = timer
     if fused:

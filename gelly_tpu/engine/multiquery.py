@@ -216,6 +216,16 @@ def fuse(queries, *, name: str | None = None,
                 "(emit-and-reset windows); the fused accumulate plan "
                 "has no per-window Merger to reset through — un-fusable"
             )
+        windowed_panes = getattr(q.agg, "windowed_panes", None)
+        if windowed_panes is not None:
+            raise ValueError(
+                f"query {q.name!r} ({q.agg.name}) carries a pane ring "
+                f"(windowed_panes={windowed_panes}): the ring's "
+                "two-stack suffix aggregation and TTL session rebuilds "
+                "are single-stream host structures the shared fused "
+                "fold cannot mask per query — run the windowed query "
+                "as its own stream (windowed= on run_aggregation)"
+            )
         if q.agg.transform is not None and not q.agg.jit_transform:
             raise ValueError(
                 f"query {q.name!r} ({q.agg.name}) uses a host-side "

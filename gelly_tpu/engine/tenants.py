@@ -1168,13 +1168,12 @@ class MultiTenantEngine:
             backlog_max = 0.0
             hb_due = hb is not None and hb.due()
             if _telemetry_on():
-                # Rate-limited: each tenant's backlog_age is an
-                # O(pending) ledger scan under the shared watermark
-                # lock, so a busy scheduler must not pay N scans per
-                # dispatch round. Idle rounds and due heartbeats
-                # publish unconditionally (the converged view, and the
-                # beat's headline field, stay fresh); dispatching
-                # rounds refresh at most every 0.5 s.
+                # Rate-limited: backlog_age is O(1) amortized since the
+                # watermark min-deque, but N gauge writes per dispatch
+                # round still churn the bus for no reader, so dispatching
+                # rounds refresh at most every 0.5 s. Idle rounds and due
+                # heartbeats publish unconditionally (the converged view,
+                # and the beat's headline field, stay fresh).
                 now = _time.monotonic()
                 if not advanced or hb_due or now >= gauge_next:
                     gauge_next = now + 0.5

@@ -57,6 +57,19 @@ class CCCompactSummary(NamedTuple):
     vertex_of: jax.Array  # i32[M] global vertex slot per cid (-1 unassigned)
 
 
+class CCWindowPane(NamedTuple):
+    """One PANE of the windowed compact plan (``windowed=W``): the pane's
+    own forest and first-seen decode rows, plus the exact touched-cid
+    mask — the window-membership predicate (a self-loop-only vertex
+    never moves ``croot`` off the identity, so ``touched`` is recorded
+    from the wire payload lanes, not inferred from the forest) and the
+    TTL last-seen source."""
+
+    croot: jax.Array  # i32[M] union-find forest over compact ids
+    vertex_of: jax.Array  # i32[M] global vertex slot per cid (-1 unassigned)
+    touched: jax.Array  # bool[M] cids referenced by this pane's payloads
+
+
 def _native_ok() -> bool:
     """Is the native chunk combiner available? (Probed once, negative-cached
     in utils.native so a missing toolchain doesn't re-run g++ per chunk.)"""
@@ -152,6 +165,7 @@ def connected_components_compact(
     compact_capacity: int | None = None, wire: str = "auto",
     unit_block: int = 1 << 18, merge_mode: str = "auto",
     delta_auto_rows: int | None = None,
+    windowed: int | None = None, ttl_panes: int | None = None,
 ) -> SummaryAggregation:
     """CC over a **persistent compact root space** — the large-N fast path
     (``codec="compact"``).
@@ -191,6 +205,19 @@ def connected_components_compact(
     - ``"pairs"`` — the per-chunk sparse combine + (v, root-index) pair
       rows (round 4's format; the no-native-toolchain fallback).
     - ``"auto"`` (default) — segments when the native codec is available.
+
+    ``windowed=W`` builds the PANE-RING variant: the summary type grows
+    an exact touched-cid mask (:class:`CCWindowPane`) so the engine's
+    ring answers "components over the last W panes" (labels cover only
+    window-touched vertices), and the plan exports the persistent-id /
+    TTL hooks (``windowed_persist_*``, ``windowed_touched``,
+    ``windowed_evict``, ``on_resume_windowed``) the engine's TTL decay
+    and exactly-once ring resume ride. ``ttl_panes=T`` (T >= W) arms
+    per-vertex decay: a cid slot untouched for T panes is evicted and
+    its session capacity reclaimed at the next pane boundary. The
+    windowed variant is merge_mode="replicated" only (a pane ring
+    retires panes; the dirty-delta merge folds into a carried global —
+    exclusive memory models).
     """
     from ..ops.compact_space import CompactIdSession
     from ..utils import native
@@ -478,6 +505,27 @@ def connected_components_compact(
             unionfind.pointer_jump(s.croot), s.vertex_of
         )
 
+    if windowed is not None:
+        return _windowed_compact_variant(
+            windowed, ttl_panes, m, n, session,
+            init=init, fold=fold, combine=combine, transform=transform,
+            merge_stacked=merge_stacked if merge == "gather" else None,
+            host_compress=(
+                host_compress_raw if use_segments else host_compress
+            ),
+            fold_compressed=(
+                fold_segments if use_segments else fold_compressed
+            ),
+            stack_payloads=(
+                stack_segments if use_segments else stack_compact
+            ),
+            member_key="m" if use_segments else "v",
+        )
+    if ttl_panes is not None:
+        raise ValueError(
+            "ttl_panes requires windowed=W (TTL stamps are last-seen "
+            "PANE indices; there is no pane clock without a ring)"
+        )
     agg = SummaryAggregation(
         init=init,
         fold=fold,
@@ -508,6 +556,151 @@ def connected_components_compact(
     )
     agg.session = session
     agg.compact_capacity = m
+    return agg
+
+
+def _windowed_compact_variant(
+    windowed: int, ttl_panes: int | None, m: int, n: int, session,
+    *, init, fold, combine, transform, merge_stacked, host_compress,
+    fold_compressed, stack_payloads, member_key: str,
+) -> SummaryAggregation:
+    """Assemble the pane-ring compact plan: wrap the base compact fold /
+    combine / transform in :class:`CCWindowPane` (an exact touched-cid
+    mask rides every pane) and attach the engine's windowed hooks.
+
+    The touched mask is recorded from the WIRE payload's member lanes
+    (``v`` on the pairs wire, ``m`` on the segments wire; padding lanes
+    are -1), not inferred from the forest — a self-loop-only vertex
+    never moves ``croot`` off the identity, yet it IS in the window.
+
+    ``windowed_evict`` (the TTL hook): survivors are renumbered
+    order-preserving onto a dense cid prefix, every live pane's leaves
+    are gathered through the renumbering, and the session is rebuilt
+    from the compacted persistent map — so ``session.assigned`` drops
+    back to the live-slot count and the freed capacity is reusable.
+    Sound because T >= W (engine-enforced): an evicted cid is untouched
+    in every live pane, so its rows are identity/-1/False everywhere
+    and no surviving cid's ``croot`` can point at it (a union would
+    have stamped it touched).
+    """
+    if windowed < 1:
+        raise ValueError(f"windowed must be >= 1 pane, got {windowed}")
+    if ttl_panes is not None and ttl_panes < windowed:
+        raise ValueError(
+            f"ttl_panes={ttl_panes} < windowed={windowed}: a slot must "
+            "outlive the ring (T >= W) so eviction never rewrites a "
+            "pane that still references it"
+        )
+
+    def init_pane() -> CCWindowPane:
+        s = init()
+        return CCWindowPane(s.croot, s.vertex_of, jnp.zeros((m,), bool))
+
+    def fold_pane(s: CCWindowPane, payload) -> CCWindowPane:
+        base = fold_compressed(
+            CCCompactSummary(s.croot, s.vertex_of), payload
+        )
+        mem = jnp.atleast_2d(payload[member_key]).reshape(-1)
+        touched = s.touched.at[jnp.where(mem >= 0, mem, m)].set(
+            True, mode="drop"
+        )
+        return CCWindowPane(base.croot, base.vertex_of, touched)
+
+    def combine_pane(a: CCWindowPane, b: CCWindowPane) -> CCWindowPane:
+        c = combine(
+            CCCompactSummary(a.croot, a.vertex_of),
+            CCCompactSummary(b.croot, b.vertex_of),
+        )
+        return CCWindowPane(c.croot, c.vertex_of, a.touched | b.touched)
+
+    def merge_stacked_pane(st: CCWindowPane) -> CCWindowPane:
+        c = merge_stacked(CCCompactSummary(st.croot, st.vertex_of))
+        return CCWindowPane(
+            c.croot, c.vertex_of, jnp.any(st.touched, axis=0)
+        )
+
+    def transform_pane(s: CCWindowPane) -> jax.Array:
+        # Same shape as the base transform, with the WINDOW-membership
+        # predicate: labels cover touched cids only (the engine
+        # substitutes the persistent vertex_of before this runs, so
+        # every touched cid decodes).
+        root = unionfind.pointer_jump(s.croot)
+        ok = s.touched & (s.vertex_of >= 0)
+        canon = jnp.full((m,), segments.INT_MAX, jnp.int32).at[
+            jnp.where(ok, root, m)
+        ].min(jnp.where(ok, s.vertex_of, segments.INT_MAX), mode="drop")
+        lab_c = canon[root]
+        return jnp.full((n,), -1, jnp.int32).at[
+            jnp.where(ok, s.vertex_of, n)
+        ].set(jnp.where(ok, lab_c, -1), mode="drop")
+
+    def flatten_pane(s: CCWindowPane) -> CCWindowPane:
+        return CCWindowPane(
+            unionfind.pointer_jump(s.croot), s.vertex_of, s.touched
+        )
+
+    def windowed_evict(panes, persist, stale):
+        # Host-side, called by the engine at a pane boundary with the
+        # pipeline quiesced (prefetch_depth=0 / h2d_depth=0 — no
+        # staged-but-unfolded payloads carry the old cids).
+        assigned = session.assigned
+        surv = np.flatnonzero(~np.asarray(stale)[:assigned])
+        k = surv.shape[0]
+        perm = np.full((m,), -1, np.int32)
+        perm[surv] = np.arange(k, dtype=np.int32)
+        out = []
+        for p in panes:
+            croot = np.arange(m, dtype=np.int32)
+            croot[:k] = perm[np.asarray(p.croot)[surv]]
+            vof = np.full((m,), -1, np.int32)
+            vof[:k] = np.asarray(p.vertex_of)[surv]
+            tch = np.zeros((m,), bool)
+            tch[:k] = np.asarray(p.touched)[surv]
+            out.append(CCWindowPane(croot, vof, tch))
+        p2 = np.full((m,), -1, np.int32)
+        p2[:k] = np.asarray(persist)[surv]
+        session.rebuild_from_vertex_of(p2)
+        return out, p2, surv
+
+    agg = SummaryAggregation(
+        init=init_pane,
+        fold=fold,
+        combine=combine_pane,
+        transform=transform_pane,
+        merge_stacked=(
+            merge_stacked_pane if merge_stacked is not None else None
+        ),
+        transient=False,
+        host_compress=host_compress,
+        fold_compressed=fold_pane,
+        stack_payloads=stack_payloads,
+        fold_accumulates=True,
+        flatten=flatten_pane,
+        requires_codec=True,
+        stack_ordered=True,
+        on_stage_error=session.complete_turn,
+        on_run_start=session.reset,
+        ordered_wait_s=lambda: session.wait_s,
+        merge_mode="replicated",
+        name="connected-components-compact-windowed",
+    )
+    agg.session = session
+    agg.compact_capacity = m
+    agg.windowed_panes = int(windowed)
+    if ttl_panes is not None:
+        agg.windowed_ttl_panes = int(ttl_panes)
+    agg.windowed_persist_init = lambda: jnp.full((m,), -1, jnp.int32)
+    agg.windowed_persist_update = jax.jit(
+        lambda p, pane: jnp.maximum(p, pane.vertex_of)
+    )
+    agg.windowed_query_fixup = lambda q, persist: q._replace(
+        vertex_of=persist
+    )
+    agg.windowed_touched = lambda pane: pane.touched
+    agg.windowed_evict = windowed_evict
+    agg.on_resume_windowed = lambda persist: session.rebuild_from_vertex_of(
+        np.asarray(persist)
+    )
     return agg
 
 
@@ -640,6 +833,7 @@ def connected_components(
     codec: str = "auto", compact_capacity: int | None = None,
     fold_backend: str = "auto", merge_mode: str = "auto",
     delta_auto_rows: int | None = None,
+    windowed: int | None = None, ttl_panes: int | None = None,
 ) -> SummaryAggregation:
     """Build the CC aggregation over a slot space of ``vertex_capacity``.
 
@@ -698,6 +892,15 @@ def connected_components(
     never run the raw dedup kernel, so the knob only shapes the
     codec-off fold path (window mode, ``ingest_combine=False``, and the
     device-bound bench).
+
+    ``windowed=W`` marks the plan for the engine's sliding pane ring
+    (``run_aggregation(windowed=...)``): emissions cover the last W
+    merge windows instead of the whole stream, at O(1) amortized
+    combines per pane close. Forces ``merge_mode="replicated"`` (the
+    dirty-delta merge folds into a carried global — incompatible with
+    pane retirement). ``ttl_panes=T`` (per-vertex decay) additionally
+    needs ``codec="compact"`` — only the compact-id session has an
+    eviction hook.
     """
     from ..engine.aggregation import resolve_sparse_codec
 
@@ -707,7 +910,25 @@ def connected_components(
         return connected_components_compact(
             vertex_capacity, merge=merge, compact_capacity=compact_capacity,
             merge_mode=merge_mode, delta_auto_rows=delta_auto_rows,
+            windowed=windowed, ttl_panes=ttl_panes,
         )
+    if ttl_panes is not None:
+        raise ValueError(
+            "ttl_panes needs the compact-id plan (codec='compact'): "
+            "per-vertex decay evicts through the CompactIdSession "
+            "rebuild hook, which dense/sparse plans have no analog of"
+        )
+    if windowed is not None:
+        if int(windowed) < 1:
+            raise ValueError(
+                f"windowed must be >= 1 pane, got {windowed}"
+            )
+        # A pane ring retires panes, so the dirty-delta merge (which
+        # folds into a CARRIED global summary) cannot engage — the
+        # windowed variant is replicated-merge only, and CCSummary
+        # needs no other change: `seen` already gives the window-
+        # membership predicate once panes fold from fresh locals.
+        merge_mode = "replicated"
     n = vertex_capacity
     sparse = resolve_sparse_codec(codec, n)
     backend = resolve_fold_backend(fold_backend, n)
@@ -857,8 +1078,10 @@ def connected_components(
         return CCSummary(unionfind.pointer_jump(s.parent), s.seen)
 
     _mk_delta, _mk_count = _cc_merge_delta(n)
+    if windowed is not None:
+        _mk_delta = _mk_count = None
 
-    return SummaryAggregation(
+    agg = SummaryAggregation(
         init=init,
         fold=fold,
         combine=combine,
@@ -900,10 +1123,15 @@ def connected_components(
         # merge_delta_crossover block measures the real bound per chip;
         # delta_auto_rows carries the calibrated value in.
         merge_delta_auto_rows=(
-            n // 4 if delta_auto_rows is None else int(delta_auto_rows)
+            None if windowed is not None
+            else n // 4 if delta_auto_rows is None
+            else int(delta_auto_rows)
         ),
         name=f"connected-components-{merge}",
     )
+    if windowed is not None:
+        agg.windowed_panes = int(windowed)
+    return agg
 
 
 def cc_query(vertex_capacity: int, *, name: str = "cc",

@@ -29,7 +29,7 @@ from ..parallel.mesh import SHARD_AXIS
 
 def degree_aggregate(vertex_capacity: int, count_out: bool = True,
                      count_in: bool = True, ingest_combine: bool = True,
-                     codec: str = "auto"):
+                     codec: str = "auto", windowed: int | None = None):
     """Continuous degree aggregate as a SummaryAggregation — the engine
     form of ``getDegrees`` (SimpleEdgeStream.java:413-478, BASELINE
     workload #1): summary = dense degree vector, fold = ±1 endpoint
@@ -44,6 +44,12 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
     small n_v) / ``"sparse"`` (counted (vertex, net-delta) pairs — payload
     and host work ∝ touched vertices, the large-n_v format) / ``"auto"``
     (sparse iff ``vertex_capacity >= SPARSE_CODEC_MIN_CAPACITY``).
+
+    ``windowed=W`` marks the plan for the engine's sliding pane ring
+    (``run_aggregation(windowed=...)``): emissions are degrees over the
+    last W merge windows only. Degree vectors add elementwise, so no
+    summary change is needed — panes fold from fresh zeros and the ring
+    sums the live suffix at O(1) amortized combines per close.
     """
     from ..engine.aggregation import (
         SummaryAggregation,
@@ -155,7 +161,9 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
             deg, jnp.where(ok, v, 0), payload["d"].reshape(-1), ok
         )
 
-    return SummaryAggregation(
+    if windowed is not None and int(windowed) < 1:
+        raise ValueError(f"windowed must be >= 1 pane, got {windowed}")
+    agg = SummaryAggregation(
         init=init,
         fold=fold,
         combine=lambda a, b: a + b,
@@ -184,6 +192,9 @@ def degree_aggregate(vertex_capacity: int, count_out: bool = True,
         fold_accumulates=True,  # degree vectors add elementwise
         name="degree-aggregate",
     )
+    if windowed is not None:
+        agg.windowed_panes = int(windowed)
+    return agg
 
 
 def degrees_query(vertex_capacity: int, *, name: str = "degrees",

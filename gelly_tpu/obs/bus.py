@@ -170,6 +170,20 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
                                       headline)
 ``obs.flight_dumps``                  flight-recorder trace dumps
                                       written (dump_on triggers)
+``windows.panes_closed``              pane closes on the windowed ring
+                                      (one per merge-window boundary)
+``windows.combine_dispatches``        two-stack ``combine`` dispatches
+                                      paid by the ring — O(1) amortized
+                                      per pane close regardless of W
+``windows.evicted_slots``             compact-id slots reclaimed by TTL
+                                      decay, cumulative
+``windows.snapshot_reads``            windowed ``snapshot()`` epoch
+                                      handles served
+``windows.ring_live``                 panes currently live in the ring
+                                      (gauge; ≤ W)
+``windows.live_slots``                compact-id slots assigned after
+                                      the pane's TTL sweep (gauge — the
+                                      bounded steady-state capacity)
 ====================================  =================================
 
 Histogram names (``bus.observe(name, value_ms)`` — latency
@@ -208,6 +222,10 @@ only when a tracer is installed or :func:`recording` is on):
                                       (lock wait + swap — the reader-
                                       contention signal; the window's
                                       compute wall is merge_emit_ms)
+``windows.pane_close_ms``             windowed pane close wall — pane
+                                      capture + ring push + suffix
+                                      query + transform (scales with
+                                      pane size, not window length)
 ====================================  =================================
 
 Tests that need isolation wrap the block in :func:`scope`, which swaps

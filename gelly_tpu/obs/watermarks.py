@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 
 def _take_range(stamps: dict, start: int, stop: int,
@@ -64,12 +65,67 @@ def _take_range(stamps: dict, start: int, stop: int,
 
 
 class _Stream:
-    __slots__ = ("stamps", "base", "folded")
+    __slots__ = ("stamps", "base", "folded", "minq", "dirty")
 
     def __init__(self, base: int = 0):
         self.stamps: dict[int, float] = {}  # position -> monotonic ingress
         self.base = base  # positions below are retired/pre-resume
         self.folded = base  # positions below had ingress->fold observed
+        # Monotonic min-deque over (position, ingress) pairs: positions
+        # strictly increase front->back, ingress times strictly increase
+        # front->back (back entries with ingress >= a new stamp's are
+        # dominated — they retire no later and are never the minimum —
+        # so the push pops them). The front is therefore the oldest
+        # pending ingress, making backlog_age O(1) amortized instead of
+        # an O(pending) ledger scan under the shared lock. Out-of-order
+        # stamps (position <= the back's) would break the position
+        # invariant, so they flip ``dirty`` and the deque is rebuilt
+        # lazily from the ledger on the next read — the hot in-order
+        # path never pays for the rare reordered arrival.
+        self.minq: deque = deque()
+        self.dirty = False
+
+
+def _minq_push(st: _Stream, position: int, t: float) -> None:
+    """Maintain the min-deque for an in-order stamp (lock held)."""
+    if st.dirty:
+        return
+    if st.minq and position <= st.minq[-1][0]:
+        st.dirty = True
+        st.minq.clear()
+        return
+    while st.minq and st.minq[-1][1] >= t:
+        st.minq.pop()
+    st.minq.append((position, t))
+
+
+def _minq_oldest(st: _Stream) -> float | None:
+    """Oldest pending ingress time, or None when the ledger is empty
+    (lock held). Rebuilds the deque after out-of-order stamps; pops
+    retired fronts; cross-checks the front against the ledger so a
+    stale entry can never be reported as the watermark."""
+    if not st.stamps:
+        st.minq.clear()
+        st.dirty = False
+        return None
+    if st.dirty:
+        st.minq.clear()
+        for pos in sorted(st.stamps):
+            _t = st.stamps[pos]
+            while st.minq and st.minq[-1][1] >= _t:
+                st.minq.pop()
+            st.minq.append((pos, _t))
+        st.dirty = False
+    while st.minq:
+        pos, t = st.minq[0]
+        if pos < st.base or st.stamps.get(pos) != t:
+            st.minq.popleft()
+            continue
+        return t
+    # Every deque entry was dominated by a since-retired stamp: fall
+    # back to one scan and rebuild via the dirty path next read.
+    st.dirty = True
+    return min(st.stamps.values())
 
 
 class Watermarks:
@@ -99,6 +155,8 @@ class Watermarks:
             st.folded = max(st.folded, st.base)
             for pos in [p for p in st.stamps if p < st.base]:
                 del st.stamps[pos]
+            while st.minq and st.minq[0][0] < st.base:
+                st.minq.popleft()
 
     def stamp(self, stream, position: int, t: float | None = None) -> None:
         """Record the ingress time of ``position`` (first stamp wins —
@@ -113,6 +171,7 @@ class Watermarks:
             if position < st.base or position in st.stamps:
                 return
             st.stamps[position] = now
+            _minq_push(st, position, now)
 
     # ------------------------------------------------------------ retiring
 
@@ -157,6 +216,8 @@ class Watermarks:
             # drops sub-base arrivals, so nothing lives below base.
             done = _take_range(st.stamps, st.base, upto, pop=True)
             st.base = max(st.base, upto)
+            while st.minq and st.minq[0][0] < st.base:
+                st.minq.popleft()
         if bus is not None and prefix is not None:
             for t in done:
                 bus.observe(f"{prefix}.e2e_ingress_to_durable_ms",
@@ -188,19 +249,27 @@ class Watermarks:
             for pos, t in src.stamps.items():
                 if pos >= dst.base and pos not in dst.stamps:
                     dst.stamps[pos] = t
+            # Merged stamps land in arbitrary position order relative
+            # to dst's deque — rebuild lazily at the next read.
+            dst.dirty = True
+            dst.minq.clear()
 
     # ------------------------------------------------------------- reading
 
     def backlog_age(self, stream) -> float:
         """Seconds since the oldest unretired ingress stamp (the low
         watermark's age); 0.0 for an empty/unknown stream. Never
-        negative."""
+        negative. O(1) amortized via the per-stream min-deque (stamps
+        arrive in position order on every hot path, so reads pop at
+        most what retirement already paid for)."""
         now = self._clock()
         with self._lock:
             st = self._streams.get(stream)
-            if st is None or not st.stamps:
+            if st is None:
                 return 0.0
-            oldest = min(st.stamps.values())
+            oldest = _minq_oldest(st)
+        if oldest is None:
+            return 0.0
         return max(0.0, now - oldest)
 
     def oldest_position(self, stream) -> int | None:
@@ -217,8 +286,9 @@ class Watermarks:
         admission-control headline."""
         now = self._clock()
         with self._lock:
-            oldest = [min(st.stamps.values())
-                      for st in self._streams.values() if st.stamps]
+            oldest = [t for t in (_minq_oldest(st)
+                                  for st in self._streams.values())
+                      if t is not None]
         if not oldest:
             return 0.0
         return max(0.0, now - min(oldest))
@@ -232,8 +302,8 @@ class Watermarks:
             for key, st in self._streams.items():
                 pending = len(st.stamps)
                 oldest = min(st.stamps) if st.stamps else None
-                age = (max(0.0, now - min(st.stamps.values()))
-                       if st.stamps else 0.0)
+                t0 = _minq_oldest(st)
+                age = max(0.0, now - t0) if t0 is not None else 0.0
                 out[str(key)] = {
                     "backlog_age_s": round(age, 6),
                     "oldest_position": oldest,

@@ -1,0 +1,72 @@
+"""Subprocess body for the windowed pane-ring kill -9 crash test
+(test_windows.py).
+
+Runs the FULL pipelined engine path over a windowed compact CC plan —
+codec workers, double-buffered H2D, donated folds, pane-ring closes with
+checkpoints at pane boundaries — throttled so the kill lands mid-pane
+with units in flight past the recorded position. The second incarnation
+resumes (``resume=True`` once the checkpoint exists) and must reproduce
+the unkilled run exactly: same pane count, final windowed labels
+bit-identical — proving one checkpoint position covers the ring, the
+pane index, and the compact-id session together.
+
+argv: <checkpoint_path> <out_npz> [emit_sleep_seconds]
+Env: GELLY_WIN_EDGES / _NV / _CHUNK override the stream shape.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gelly_tpu import edge_stream_from_edges  # noqa: E402
+from gelly_tpu.engine.checkpoint import save_checkpoint  # noqa: E402
+from gelly_tpu.library.connected_components import (  # noqa: E402
+    connected_components,
+)
+
+N_EDGES = int(os.environ.get("GELLY_WIN_EDGES", "2048"))
+N_V = int(os.environ.get("GELLY_WIN_NV", "128"))
+CHUNK = int(os.environ.get("GELLY_WIN_CHUNK", "32"))
+WINDOW = 4  # panes per sliding window; pane = merge_every chunks
+
+
+def build_stream():
+    rng = np.random.default_rng(13)
+    pairs = rng.integers(0, N_V, (N_EDGES, 2))
+    return edge_stream_from_edges(
+        [(int(a), int(b)) for a, b in pairs],
+        vertex_capacity=N_V, chunk_size=CHUNK,
+    )
+
+
+def main(argv):
+    ckpt_path, out_path = argv[0], argv[1]
+    sleep_s = float(argv[2]) if len(argv) > 2 else 0.0
+    stream = build_stream()
+    agg = connected_components(N_V, merge="gather", codec="compact",
+                               compact_capacity=N_V, windowed=WINDOW)
+    res = stream.aggregate(
+        agg, merge_every=2,
+        checkpoint_path=ckpt_path, checkpoint_every=1,
+        resume=os.path.exists(ckpt_path),
+        codec_workers=2, h2d_depth=2,
+    )
+    labels = None
+    for labels in res:
+        if sleep_s:
+            # Throttled consumer: compress/H2D stages run ahead, so the
+            # parent's SIGKILL lands mid-pane with units in flight.
+            time.sleep(sleep_s)
+    save_checkpoint(
+        out_path,
+        [np.asarray(labels), np.asarray([res.stats["windows_closed"]])],
+        position=res.stats["chunks"])
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

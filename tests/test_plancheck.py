@@ -561,6 +561,8 @@ FUSE_SRC = textwrap.dedent('''\
                 raise TypeError("nested fusion is unsupported")
             if q.agg.transient:
                 raise ValueError("transient sub-plans are unsupported")
+            if q.agg.windowed_panes:
+                raise ValueError("windowed_panes rings are unsupported")
             if not q.agg.jit_transform:
                 raise ValueError("host-side transforms are unsupported")
             codec = q.codec

@@ -595,3 +595,113 @@ def test_stats_mid_stream_sigkill_exactly_once_and_watermarks(tmp_path):
     sampled = sampled[sampled >= 0]
     if sampled.size:
         assert int(sampled.min()) >= resume_pos
+
+
+# --------------------------------------------------------------------- #
+# watermark min-deque: O(1)-amortized backlog_age vs the ledger scan
+# (hammer/parity regression for the perf fix — the gauge read used to
+# be an O(pending) min() over the stamp dict under the shared lock)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _scan_age(wm, stream, now):
+    """The reference implementation the deque replaced: one O(pending)
+    min-scan over the raw ledger."""
+    st = wm._streams.get(stream)
+    if st is None or not st.stamps:
+        return 0.0
+    return max(0.0, now - min(st.stamps.values()))
+
+
+def test_watermark_minq_parity_hammer_vs_scan():
+    from gelly_tpu.obs.watermarks import Watermarks
+
+    rng = np.random.default_rng(7)
+    ck = _FakeClock()
+    wm = Watermarks(clock=ck)
+    streams = ["a", "b"]
+    base = {s: 0 for s in streams}
+    nxt = {s: 0 for s in streams}
+    reads = 0
+    for _ in range(4000):
+        ck.t += float(rng.random()) * 0.01
+        s = streams[int(rng.integers(0, 2))]
+        nxt[s] = max(nxt[s], base[s])
+        op = float(rng.random())
+        if op < 0.55:
+            if rng.random() < 0.05 and nxt[s] > base[s]:
+                p = int(rng.integers(base[s], nxt[s]))  # out-of-order
+            else:
+                p = nxt[s]
+                nxt[s] += 1
+            wm.stamp(s, p)
+        elif op < 0.72:
+            upto = int(rng.integers(base[s], nxt[s] + 2))
+            wm.retire_durable(s, upto)
+            base[s] = max(base[s], upto)
+        elif op < 0.82:
+            wm.retire_fold(s, int(rng.integers(base[s], nxt[s] + 2)))
+        elif op < 0.90:
+            pos = int(rng.integers(base[s], nxt[s] + 2))
+            wm.seed(s, pos)
+            base[s] = max(base[s], pos)
+        else:
+            reads += 1
+            now = ck.t
+            assert wm.backlog_age(s) == pytest.approx(
+                _scan_age(wm, s, now), abs=1e-12)
+            want = max((_scan_age(wm, x, now) for x in streams),
+                       default=0.0)
+            assert wm.max_backlog_age() == pytest.approx(want, abs=1e-12)
+    assert reads > 200  # the hammer actually exercised the read path
+
+
+def test_watermark_minq_rekey_and_snapshot_parity():
+    from gelly_tpu.obs.watermarks import Watermarks
+
+    ck = _FakeClock()
+    wm = Watermarks(clock=ck)
+    for p, t in [(0, 1.0), (1, 2.0), (2, 3.0)]:
+        ck.t = t
+        wm.stamp("pre", p)
+    ck.t = 4.0
+    wm.stamp("dst", 1)
+    wm.rekey("pre", "dst")  # arbitrary-order merge -> lazy rebuild
+    ck.t = 10.0
+    assert wm.backlog_age("dst") == pytest.approx(9.0)
+    assert wm.backlog_age("pre") == 0.0
+    assert wm.snapshot()["dst"]["backlog_age_s"] == pytest.approx(9.0)
+    wm.retire_durable("dst", 2)
+    assert wm.backlog_age("dst") == pytest.approx(7.0)
+    wm.retire_durable("dst", 100)
+    assert wm.backlog_age("dst") == 0.0
+    assert wm.max_backlog_age() == 0.0
+
+
+def test_watermark_minq_in_order_reads_never_rebuild():
+    from gelly_tpu.obs.watermarks import Watermarks
+
+    ck = _FakeClock()
+    wm = Watermarks(clock=ck)
+    for p in range(512):
+        ck.t += 0.001
+        wm.stamp("s", p)
+        if p % 7 == 0:
+            wm.backlog_age("s")
+        if p % 64 == 63:
+            wm.retire_durable("s", p - 32)
+    st = wm._streams["s"]
+    # The hot path stays incremental: in-position-order traffic never
+    # flips the dirty bit (no O(n log n) rebuild), and the deque never
+    # outgrows the ledger — each entry is pushed once and popped once.
+    assert st.dirty is False
+    assert len(st.minq) <= len(st.stamps)
+    assert wm.backlog_age("s") == pytest.approx(
+        _scan_age(wm, "s", ck.t), abs=1e-12)

@@ -1,0 +1,376 @@
+"""Pane-ring sliding windows + TTL decay (core/windows.py PaneRing +
+engine/aggregation.py windowed mode).
+
+Pins down the temporal engine's contracts: two-stack suffix aggregation
+answers a W-pane sliding window in O(1) amortized combines per pane
+close; windowed labels are bit-identical to a replay oracle (re-fold
+only the last W panes' edges) on adversarial streams — hot vertex,
+self-loops, TTL eviction then re-arrival of the same vertex id; one
+checkpoint position covers ring + pane index + compact-id session
+(generator abandon here, subprocess kill -9 below); snapshots serve a
+consistent ``{window, labels}`` handle with the one-window staleness
+bound; and every plane that cannot compose panes refuses loudly.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gelly_tpu.core.io import EdgeChunkSource
+from gelly_tpu.core.stream import edge_stream_from_source
+from gelly_tpu.core.vertices import IdentityVertexTable
+from gelly_tpu.core.windows import PaneRing
+from gelly_tpu.engine.aggregation import (
+    _compiled_tenant_plan,
+    run_aggregation,
+)
+from gelly_tpu.engine.multiquery import fuse
+from gelly_tpu.library.connected_components import (
+    cc_labels_numpy,
+    connected_components,
+)
+from gelly_tpu.library.degrees import degree_aggregate
+from gelly_tpu.parallel import mesh as mesh_lib
+
+pytestmark = pytest.mark.windows
+
+N_V = 256
+CH = 64
+
+
+def _stream(src, dst, chunk_size=CH, n_v=N_V):
+    return edge_stream_from_source(
+        EdgeChunkSource(src, dst, chunk_size=chunk_size,
+                        table=IdentityVertexTable(n_v)), n_v)
+
+
+def _zipf_stream(n_chunks=20, seed=7):
+    """Hot-vertex (zipf) stream with self-loops sprinkled in."""
+    rng = np.random.default_rng(seed)
+    n_e = CH * n_chunks
+    src = (rng.zipf(1.4, n_e) % N_V).astype(np.int64)
+    dst = (rng.zipf(1.4, n_e) % N_V).astype(np.int64)
+    src[::37] = dst[::37]  # self-loops: touched, but no forest edge
+    return src, dst
+
+
+def _replay(src, dst, upto_chunk, window_panes, merge_every):
+    """The oracle: re-fold ONLY the last W panes' edges from scratch."""
+    lo = max(0, upto_chunk * CH - window_panes * merge_every * CH)
+    return cc_labels_numpy(src[lo:upto_chunk * CH],
+                           dst[lo:upto_chunk * CH], None, N_V)
+
+
+# ---------------------------------------------------------------------- #
+# PaneRing: the two-stack queue on plain Python values
+
+
+class TestPaneRing:
+    def test_sliding_sum_parity_vs_naive(self):
+        rng = np.random.default_rng(11)
+        for w in (1, 2, 3, 7, 16):
+            ring = PaneRing(w, lambda a, b: a + b)
+            vals = []
+            for i in range(5 * w + 3):
+                v = int(rng.integers(0, 1000))
+                vals.append(v)
+                ring.push(v)
+                assert ring.live == min(len(vals), w)
+                assert ring.query() == sum(vals[-w:])
+
+    def test_combines_amortized_constant(self):
+        # Two-stack contract: total combines over N pushes is O(N),
+        # independent of W — never a W-pane re-merge per close.
+        for w in (4, 16, 64):
+            ring = PaneRing(w, lambda a, b: a + b)
+            n = 8 * w
+            for i in range(n):
+                ring.push(1)
+                ring.query()
+            # flip (~1/push amortized) + back_agg (~1/push) + query
+            # front+back join (~1/query) stays under 4 per push+query.
+            assert ring.combines <= 4 * n, (w, ring.combines, n)
+
+    def test_non_commutative_order(self):
+        # Window order matters: combine = concat must reproduce the
+        # exact oldest->newest suffix, across flips and evictions.
+        w = 5
+        ring = PaneRing(w, lambda a, b: a + b)
+        items = [[i] for i in range(23)]
+        for i, it in enumerate(items):
+            ring.push(it)
+            lo = max(0, i + 1 - w)
+            assert ring.query() == sum(items[lo:i + 1], [])
+            assert ring.export_panes() == items[lo:i + 1]
+
+    def test_export_reload_roundtrip(self):
+        ring = PaneRing(4, lambda a, b: a + b)
+        for i in range(11):
+            ring.push(i)
+        ring2 = PaneRing(4, lambda a, b: a + b)
+        ring2.reload(ring.export_panes(), ring.panes_closed)
+        assert ring2.query() == ring.query()
+        assert ring2.panes_closed == ring.panes_closed
+        ring.push(99), ring2.push(99)
+        assert ring2.query() == ring.query()
+
+
+# ---------------------------------------------------------------------- #
+# windowed parity vs the replay oracle
+
+
+def test_dense_cc_windowed_parity():
+    src, dst = _zipf_stream()
+    w, me = 4, 2
+    agg = connected_components(N_V, merge="gather", codec="dense",
+                               windowed=w)
+    st = run_aggregation(agg, _stream(src, dst), merge_every=me)
+    outs = [np.asarray(o) for o in st]
+    assert len(outs) == 10 and st.stats["windows_closed"] == 10
+    for i, got in enumerate(outs):
+        want = _replay(src, dst, min((i + 1) * me, 20), w, me)
+        assert np.array_equal(got, want), f"pane {i}"
+    # O(1)-amortized combine bound, observable in the stream stats.
+    assert st.stats["ring_combines"] <= 4 * st.stats["windows_closed"]
+
+
+def test_degrees_windowed_parity():
+    src, dst = _zipf_stream(seed=9)
+    w, me = 4, 2
+    dagg = degree_aggregate(N_V, codec="dense", windowed=w)
+    # windowed rides the agg attribute: no engine kwarg needed.
+    outs = [np.asarray(o) for o in
+            run_aggregation(dagg, _stream(src, dst), merge_every=me)]
+    for i, got in enumerate(outs):
+        upto = min((i + 1) * me, 20)
+        lo = max(0, upto * CH - w * me * CH)
+        want = np.zeros(N_V, np.int64)
+        np.add.at(want, src[lo:upto * CH], 1)
+        np.add.at(want, dst[lo:upto * CH], 1)
+        assert np.array_equal(got, want), f"pane {i}"
+
+
+def test_compact_cc_windowed_ttl_parity():
+    src, dst = _zipf_stream()
+    w, me = 4, 2
+    agg = connected_components(N_V, codec="compact", compact_capacity=N_V,
+                               windowed=w, ttl_panes=w)
+    st = run_aggregation(agg, _stream(src, dst), merge_every=me,
+                         mesh=mesh_lib.make_mesh(1),
+                         prefetch_depth=0, h2d_depth=0, ingest_workers=1)
+    outs = [np.asarray(o) for o in st]
+    for i, got in enumerate(outs):
+        want = _replay(src, dst, min((i + 1) * me, 20), w, me)
+        assert np.array_equal(got, want), f"pane {i}"
+
+
+def _two_phase_stream():
+    """Phase A: vertices 0..99 active for 8 chunks; phase B: only
+    100..119 for 16 chunks; vertex 5 re-arrives at the very end."""
+    rng = np.random.default_rng(3)
+    a, b = 8 * CH, 16 * CH
+    src = np.empty(a + b, np.int64)
+    dst = np.empty(a + b, np.int64)
+    src[:a] = rng.integers(0, 100, a)
+    dst[:a] = rng.integers(0, 100, a)
+    src[a:] = rng.integers(100, 120, b)
+    dst[a:] = rng.integers(100, 120, b)
+    src[-3:] = 5
+    dst[-3:] = 110
+    return src, dst
+
+
+def _two_phase_agg(w=3, ttl=4):
+    return connected_components(N_V, codec="compact", compact_capacity=N_V,
+                                windowed=w, ttl_panes=ttl)
+
+
+def test_ttl_eviction_reclaims_capacity_and_rearrival():
+    src, dst = _two_phase_stream()
+    w, me, ttl = 3, 2, 4
+    agg = _two_phase_agg(w, ttl)
+    st = run_aggregation(agg, _stream(src, dst), merge_every=me,
+                         mesh=mesh_lib.make_mesh(1),
+                         prefetch_depth=0, h2d_depth=0, ingest_workers=1)
+    outs, assigned = [], []
+    for out in st:
+        outs.append(np.asarray(out))
+        assigned.append(agg.session.assigned)
+    # Phase A populates ~100 slots; once its panes age past the TTL the
+    # sweep releases them — steady state is bounded by the ACTIVE set.
+    assert max(assigned[:5]) > 100
+    assert assigned[-2] < 40, assigned
+    # Parity at every close, including the evicted vertex 5 re-arriving
+    # on a FRESH compact id at the end.
+    for i, got in enumerate(outs):
+        want = _replay(src, dst, min((i + 1) * me, 24), w, me)
+        assert np.array_equal(got, want), f"pane {i}"
+
+
+def test_checkpoint_resume_bit_parity(tmp_path):
+    src, dst = _two_phase_stream()
+    w, me = 3, 2
+    ck = str(tmp_path / "win-ck.npz")
+    kw = dict(merge_every=me, mesh=mesh_lib.make_mesh(1),
+              prefetch_depth=0, h2d_depth=0,
+              ingest_workers=1, checkpoint_path=ck, checkpoint_every=1)
+
+    full = [np.asarray(o) for o in
+            run_aggregation(_two_phase_agg(w), _stream(src, dst),
+                            merge_every=me, mesh=mesh_lib.make_mesh(1),
+                            prefetch_depth=0,
+                            h2d_depth=0, ingest_workers=1)]
+
+    it = iter(run_aggregation(_two_phase_agg(w), _stream(src, dst), **kw))
+    for _ in range(5):
+        next(it)
+    it.close()  # abandon mid-stream; last durable checkpoint = pane 5
+
+    st = run_aggregation(_two_phase_agg(w), _stream(src, dst),
+                         resume=True, **kw)
+    rest = [np.asarray(o) for o in st]
+    # The checkpoint for pane k lands after pane k's yield, so resume
+    # re-emits from the last checkpointed pane: align by tail.
+    assert 0 < len(rest) < len(full)
+    for i, (got, want) in enumerate(zip(rest, full[-len(rest):])):
+        assert np.array_equal(got, want), f"tail pane {i}"
+
+
+def test_snapshot_one_window_staleness():
+    src, dst = _zipf_stream(seed=5)
+    w, me = 4, 2
+    agg = connected_components(N_V, merge="gather", codec="dense",
+                               windowed=w)
+    st = run_aggregation(agg, _stream(src, dst), merge_every=me)
+    assert st.snapshot() is None  # nothing closed yet
+    outs = []
+    for out in st:
+        outs.append(np.asarray(out))
+        snap = st.snapshot()
+        # Readable while the stream advances: the handle tracks the
+        # newest CLOSED window — never ahead of a close, at most one
+        # window behind the next one the producer is filling.
+        assert snap is not None
+        assert snap["window"] == len(outs)
+        assert np.array_equal(np.asarray(snap["labels"]), outs[-1])
+    snap = st.snapshot()
+    assert snap["window"] == len(outs) == st.stats["windows_closed"]
+    assert np.array_equal(np.asarray(snap["labels"]), outs[-1])
+
+
+# ---------------------------------------------------------------------- #
+# eligibility: planes that cannot compose panes refuse loudly
+
+
+def _windowed_agg():
+    return connected_components(N_V, merge="gather", codec="dense",
+                                windowed=4)
+
+
+def test_refuses_windowed_with_window_ms():
+    src, dst = _zipf_stream()
+    with pytest.raises(ValueError, match="window_ms"):
+        run_aggregation(_windowed_agg(), _stream(src, dst), window_ms=10)
+
+
+def test_refuses_windowed_in_fuse():
+    with pytest.raises(ValueError, match="windowed"):
+        fuse([("cc", _windowed_agg()),
+              ("deg", degree_aggregate(N_V, codec="dense"))])
+
+
+def test_refuses_windowed_in_tenant_tier():
+    with pytest.raises(ValueError, match="windowed"):
+        _compiled_tenant_plan(_windowed_agg(), 2)
+
+
+def test_refuses_ttl_without_windowed():
+    with pytest.raises(ValueError, match="ttl"):
+        connected_components(N_V, codec="compact", ttl_panes=4)
+
+
+def test_refuses_ttl_shorter_than_window():
+    with pytest.raises(ValueError, match="ttl"):
+        connected_components(N_V, codec="compact", compact_capacity=N_V,
+                             windowed=4, ttl_panes=2)
+
+
+def test_refuses_ttl_on_dense_codec():
+    with pytest.raises(ValueError, match="compact"):
+        connected_components(N_V, codec="dense", windowed=4, ttl_panes=4)
+
+
+def test_refuses_ttl_with_pipeline_lookahead():
+    src, dst = _zipf_stream()
+    agg = connected_components(N_V, codec="compact", compact_capacity=N_V,
+                               windowed=4, ttl_panes=4)
+    with pytest.raises(ValueError, match="prefetch|lookahead"):
+        run_aggregation(agg, _stream(src, dst), merge_every=2,
+                        prefetch_depth=2, h2d_depth=2)
+
+
+# ---------------------------------------------------------------------- #
+# kill -9 mid-pane with units in flight (house crash-child pattern)
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_windows_crash_child.py")
+
+
+def _spawn(ckpt, out, sleep_s):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(ckpt), str(out), str(sleep_s)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.mark.faults
+def test_windowed_kill9_resume_bit_identical(tmp_path):
+    from gelly_tpu.engine.checkpoint import load_checkpoint
+
+    ckpt = tmp_path / "win-ck.npz"
+    out_clean = tmp_path / "clean.npz"
+    out_resumed = tmp_path / "resumed.npz"
+
+    p = _spawn(tmp_path / "clean-ck.npz", out_clean, 0.0)
+    assert p.wait(timeout=300) == 0
+
+    # Throttled run: SIGKILL once a pane-boundary checkpoint is durable
+    # — the pipeline guarantees units in flight past the position.
+    p = _spawn(ckpt, out_resumed, 0.05)
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if p.poll() is not None:
+            pytest.fail(f"child exited early (rc={p.returncode})")
+        if ckpt.exists():
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("no checkpoint appeared before the deadline")
+    os.kill(p.pid, signal.SIGKILL)
+    assert p.wait(timeout=60) == -signal.SIGKILL
+    assert not out_resumed.exists()
+
+    _, pos, meta = load_checkpoint(str(ckpt))
+    sys.path.insert(0, os.path.dirname(CHILD))
+    import _windows_crash_child as child
+
+    total = -(-child.N_EDGES // child.CHUNK)
+    assert 0 < pos < total  # mid-stream position
+    assert meta.get("windowed") == child.WINDOW
+    assert 0 < meta.get("ring_live", 0) <= child.WINDOW
+
+    p = _spawn(ckpt, out_resumed, 0.0)
+    assert p.wait(timeout=300) == 0
+    resumed, _, _ = load_checkpoint(str(out_resumed))
+    clean, _, _ = load_checkpoint(str(out_clean))
+    assert len(resumed) == len(clean) == 2
+    # Windowed labels AND total pane count, bit for bit.
+    assert resumed[0].tobytes() == clean[0].tobytes()
+    assert resumed[1].tobytes() == clean[1].tobytes()
