@@ -3732,13 +3732,157 @@ def bench_windows(args=None) -> dict:
     }
 
 
+# --------------------------------------------------------------------- #
+# ISSUE 20: wire trace-context stamping overhead + e2e causal trace
+
+
+def bench_obs(args):
+    """Re-prove the <2% tracer-overhead contract with WIRE trace-context
+    stamping enabled (ISSUE 20 satellite), and capture the committed
+    end-to-end causal artifact.
+
+    Interleaved best-of-3 loopback passes over the same payload set and
+    compiled plan: tracer OFF vs tracer ON. With a tracer installed the
+    client stamps every DATA frame's payload with (trace_id, span_id),
+    the server links wire_recv/staging spans to it, and the engine
+    chains fold → merge_emit → checkpoint through the tracer's context
+    registry — so the ON side is the full stamping + linking cost, not
+    just span recording. The best ON pass is exported as
+    ``trace_e2e_wire.json``: one trace_id spanning client_send →
+    wire_recv → staging → fold → checkpoint with parent span ids (the
+    committed causal-chain artifact README cites).
+
+    As with the file-ingest obs block, ``overhead_lt_2pct`` is a v5e
+    claim; the CPU capture documents the schema and records the
+    structural causal-chain booleans, which are host-relative.
+    """
+    import contextlib
+    import os
+    import tempfile
+    import threading
+
+    from gelly_tpu import obs
+    from gelly_tpu.engine.aggregation import run_aggregation
+    from gelly_tpu.ingest import IngestClient, IngestServer
+    from gelly_tpu.ingest.client import edge_payload
+    from gelly_tpu.library.connected_components import connected_components
+    from gelly_tpu.parallel import mesh as mesh_lib
+
+    n_v = 1 << 12
+    chunk = 1 << 15
+    n_chunks = 8
+    n_e = chunk * n_chunks
+    rng = np.random.default_rng(23)
+    payloads = [
+        edge_payload(rng.integers(0, n_v, chunk).astype(np.int64),
+                     rng.integers(0, n_v, chunk).astype(np.int64))
+        for _ in range(n_chunks)
+    ]
+    m1 = mesh_lib.make_mesh(1)
+    agg = connected_components(n_v)  # shared: compiled plan caches on it
+
+    def one_pass(tracer, ckpt_dir):
+        ctx = (obs.install(tracer) if tracer is not None
+               else contextlib.nullcontext())
+        with obs.scope(), ctx:
+            with IngestServer(queue_depth=64, stop_on_bye=True) as srv:
+                def feed():
+                    cli = IngestClient("127.0.0.1", srv.port,
+                                       send_pause_timeout=120)
+                    cli.connect()
+                    for p in payloads:
+                        cli.send(p)
+                    cli.flush(timeout=300)
+                    cli.close()
+
+                th = threading.Thread(target=feed, daemon=True)
+                th.start()
+                t0 = time.perf_counter()
+                # checkpoint_every is a WINDOW cadence: half-stream
+                # windows + every-window checkpoints put two durable
+                # points (and their linked checkpoint spans) in the
+                # capture.
+                res = run_aggregation(
+                    agg, srv.chunks(chunk, n_v),
+                    merge_every=n_chunks // 2, mesh=m1,
+                    checkpoint_path=os.path.join(ckpt_dir, "ck.npz"),
+                    checkpoint_every=1, ingest_workers=0,
+                    prefetch_depth=0, h2d_depth=0,
+                )
+                np.asarray(res.result())
+                wall = time.perf_counter() - t0
+                th.join(timeout=60)
+        return wall
+
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        one_pass(None, ckpt_dir)  # compile warmup outside measurement
+        dt_off = dt_on = float("inf")
+        best = None
+        for _ in range(3):
+            dt_off = min(dt_off, one_pass(None, ckpt_dir))
+            tr = obs.SpanTracer(capacity=1 << 16, heartbeat_every_s=None)
+            t = one_pass(tr, ckpt_dir)
+            if t < dt_on:
+                dt_on, best = t, tr
+
+    tpath = trace_out_path("trace_e2e_wire")
+    trace = obs.write_chrome_trace(
+        tpath, best, extra={"workload": "e2e_wire"},
+    )
+    # Structural causal-chain claims over the exported ring: every stage
+    # present, every span on the ONE trace_id, recv→staging parented to
+    # the client's send span ids.
+    sends = best.spans("client_send")
+    recvs = best.spans("wire_recv")
+    stages = best.spans("staging")
+    folds = [s for s in best.spans("fold") if "trace" in s["args"]]
+    ckpts = [s for s in best.spans("checkpoint") if "trace" in s["args"]]
+    tid = best.trace_id
+    linked = (
+        [s["args"].get("trace") for s in sends + recvs + stages]
+        + [s["args"]["trace"] for s in folds + ckpts]
+    )
+    send_ids = {s["args"]["span"] for s in sends}
+    return {
+        "metric": "obs_wire",
+        "edges": n_e,
+        "vertices": n_v,
+        "chunk_size": chunk,
+        "unit": "edges/sec",
+        "wire_off_eps": round(n_e / dt_off, 1),
+        "wire_on_eps": round(n_e / dt_on, 1),
+        "overhead_frac": round(max(0.0, dt_on / dt_off - 1.0), 4),
+        "overhead_lt_2pct": bool(dt_on / dt_off - 1.0 < 0.02),
+        "trace_file": os.path.basename(tpath),
+        "trace_events": len(trace["traceEvents"]),
+        "trace_id": tid,
+        "causal_chain": {
+            "client_send_spans": len(sends),
+            "wire_recv_spans": len(recvs),
+            "staging_spans": len(stages),
+            "fold_spans_linked": len(folds),
+            "checkpoint_spans_linked": len(ckpts),
+            "single_trace_id": bool(
+                linked and all(t == tid for t in linked)),
+            "recv_parented_to_send": bool(
+                recvs and all(r["args"].get("parent") in send_ids
+                              for r in recvs)),
+        },
+        "scaling_measurable": False,
+        "skipped_reason": (
+            "1-core CPU stand-in: overhead_lt_2pct is a v5e claim; the "
+            "committed claims here are the causal-chain booleans"
+        ),
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--workload", default="all",
                    choices=["all", "cc", "cc_large", "degrees", "triangles",
                             "bipartiteness", "matching", "spanner", "codec",
                             "gather", "ingest", "tenants", "multiquery",
-                            "windows"])
+                            "windows", "obs"])
     # K-points for the subprocess codec-scaling sweep (codec_workers_eps):
     # comma list; oversubscribed K on small hosts is fine (the points then
     # bound, rather than exhibit, scaling).
@@ -3802,6 +3946,10 @@ def main() -> int:
         return 0
     if args.workload == "windows":
         emit(bench_windows(args))
+        write_bench_artifact(args.workload)
+        return 0
+    if args.workload == "obs":
+        emit(bench_obs(args))
         write_bench_artifact(args.workload)
         return 0
     if args.workload == "spanner":

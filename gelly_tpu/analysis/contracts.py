@@ -83,6 +83,20 @@ consumes :func:`gelly_tpu.ingest.wire.read_frame_checked`):
   unconditionally: dropping an un-acked frame makes the
   crash-resume retransmit impossible.
 
+**AL — alert-plane isolation rules** (the push-alert channel is
+best-effort BY CONTRACT — ``ingest/wire.py`` documents ALERT delivery
+as outside the exactly-once data seq space):
+
+- ``AL001`` alert sends must be stateless w.r.t. the data protocol: a
+  scope that sends an ALERT frame (``pack_frame(ALERT, ...)``) must
+  not store to sequence/ack attributes (``*next_seq*``/``*expect*``/
+  ``*acked*``), register frames into a resend buffer
+  (``*unacked*``/``*resend*``), or stage payloads
+  (``_enqueue``/``put``/``put_nowait``). An alert push that touches
+  seq/ack/resend state silently couples the lossy channel to the
+  exactly-once one — a dropped alert would then corrupt data-stream
+  bookkeeping.
+
 **OB — observability drift rules** (OB001/OB002 activate only when the
 lint set includes the glossary module — a ``bus.py`` whose docstring
 carries the ``\\`\\`subsystem.name\\`\\`` table; OB002 additionally
@@ -191,6 +205,14 @@ RULES: dict[str, tuple[str, str]] = {
         "glossary entry no call site emits",
         "dead docs misdirect an operator mid-incident: delete the "
         "entry or re-point it at the name the code actually publishes",
+    ),
+    "AL001": (
+        "alert-sending scope mutates exactly-once protocol state",
+        "ALERT delivery is best-effort by contract: a scope that packs "
+        "an ALERT frame must not store to seq/ack attributes, register "
+        "into a resend buffer, or stage payloads — keep the push "
+        "closure read-only w.r.t. the data protocol so a dropped alert "
+        "can never corrupt data-stream bookkeeping",
     ),
     "OB003": (
         "one name published under more than one metric kind",
@@ -382,6 +404,7 @@ class ContractChecker:
         self._wp001(m, nodes, calls, fname)
         self._wp002(m, nodes, fname)
         self._wp003(m, nodes, fname)
+        self._al001(m, nodes, calls, fname)
 
     def _durability_lines(self, calls) -> list:
         out = []
@@ -813,6 +836,41 @@ class ContractChecker:
                     "— resend frames may only be dropped below an "
                     "ack-derived bound",
                 )
+
+    # -------------------------------------------------------- AL family
+
+    def _al001(self, m, nodes, calls, fname) -> None:
+        # Scope sends ALERT frames? Then the whole scope must be
+        # read-only w.r.t. the exactly-once protocol: no seq/ack
+        # stores, no resend-buffer registration, no staging.
+        sends_alert = any(
+            (chain := _attr_chain(c.func)) and chain[-1] == "pack_frame"
+            and c.args and "alert" in _unparse(c.args[0])
+            for c in calls
+        )
+        if not sends_alert:
+            return
+        for node, what in self._wp2_mutations(nodes):
+            self._emit(
+                m, node, "AL001",
+                f"{what} in the ALERT-sending scope {fname!r}",
+            )
+        for n in nodes:
+            tgts = []
+            if isinstance(n, ast.Assign):
+                tgts = n.targets
+            elif isinstance(n, ast.AugAssign):
+                tgts = [n.target]
+            for t in tgts:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute) \
+                        and _RESEND_BUF.search(t.value.attr):
+                    self._emit(
+                        m, n, "AL001",
+                        f"resend-buffer registration into "
+                        f"{t.value.attr!r} in the ALERT-sending scope "
+                        f"{fname!r}",
+                    )
 
     # -------------------------------------------------------- OB family
 

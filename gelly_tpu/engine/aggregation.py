@@ -1852,9 +1852,13 @@ def run_aggregation(
                 bus.gauge("engine.backlog_age_s",
                           round(wm.backlog_age("stream"), 6))
             if tracer is not None:
+                cctx = tracer.ctx(("fold", chunks_consumed))
+                clink = ({"trace": cctx[0], "parent": cctx[1]}
+                         if cctx is not None else {})
                 tracer.span("checkpoint", "checkpoint", t_ck,
                             position=chunks_consumed,
-                            windows=windows_closed, bytes=ck_bytes)
+                            windows=windows_closed, bytes=ck_bytes,
+                            **clink)
             if allowed_lateness:
                 # Only after the main write is durable: stale sidecars
                 # (older positions, or the legacy unstamped name) are no
@@ -2369,8 +2373,25 @@ def run_aggregation(
                         wm.retire_fold("stream", chunks_consumed,
                                        bus=bus, prefix="engine")
                     if tracer is not None:
+                        # Causal link to the wire: the server's staging
+                        # bound each chunk position to its frame's
+                        # trace context (ingest/server.py); the unit's
+                        # first position carries it onto the fold span,
+                        # and the fold frontier is re-bound under a
+                        # distinct key so the covering checkpoint/merge
+                        # can pick the chain up without clobbering
+                        # staging bindings for incoming positions.
+                        fctx = tracer.ctx(chunks_consumed - k)
+                        fold_sid = tracer.next_span_id()
+                        link = ({"trace": fctx[0], "parent": fctx[1]}
+                                if fctx is not None else {})
                         tracer.span("fold", "fold", t_fold, unit=seq,
-                                    chunks=k, edges=edges, **fold_attrs)
+                                    chunks=k, edges=edges, span=fold_sid,
+                                    **link, **fold_attrs)
+                        tracer.bind_ctx(
+                            ("fold", chunks_consumed),
+                            fctx[0] if fctx is not None else tracer.trace_id,
+                            fold_sid)
                         if edges:
                             meter.record(edges)
                             bus.inc("engine.edges_folded", edges)
@@ -2394,6 +2415,8 @@ def run_aggregation(
                                     "engine.fold_dispatch_ms", 0.99), 3),
                                 backlog_age_max_s=round(
                                     bus.watermarks.max_backlog_age(), 3),
+                                slo_breaching=int(bus.gauges.get(
+                                    "slo.breaching", 0)),
                             )
                             staged_hw = 0
                     chunks_in_window += k
@@ -2413,8 +2436,12 @@ def run_aggregation(
                             bus.observe("engine.merge_emit_ms",
                                         (time.perf_counter() - t_h) * 1e3)
                         if tracer is not None:
+                            mctx = tracer.ctx(("fold", chunks_consumed))
+                            mlink = ({"trace": mctx[0], "parent": mctx[1]}
+                                     if mctx is not None else {})
                             tracer.span("merge_emit", "merge_emit",
-                                        t_merge, window=windows_closed)
+                                        t_merge, window=windows_closed,
+                                        **mlink)
                         chunks_in_window = 0
                         publish_watermarks()
                         yield out
@@ -2429,8 +2456,12 @@ def run_aggregation(
                         bus.observe("engine.merge_emit_ms",
                                     (time.perf_counter() - t_h) * 1e3)
                     if tracer is not None:
+                        mctx = tracer.ctx(("fold", chunks_consumed))
+                        mlink = ({"trace": mctx[0], "parent": mctx[1]}
+                                 if mctx is not None else {})
                         tracer.span("merge_emit", "merge_emit", t_merge,
-                                    window=windows_closed, final=True)
+                                    window=windows_closed, final=True,
+                                    **mlink)
                     publish_watermarks()
                     yield out
                     maybe_checkpoint(force=True)

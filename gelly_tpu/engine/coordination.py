@@ -755,9 +755,14 @@ class Coordinator:
             what=f"barrier epoch {epoch}: intents",
         )
         agreed = max(intents.values())
+        # t_wall: wall-clock stamp for the multi-host trace stitcher —
+        # per-host rings use monotonic clocks with unrelated epochs, so
+        # stitch_traces aligns on this instant (matched by `epoch`) and
+        # t_wall is the recorded fallback evidence of the true skew.
         obs_bus.get_bus().emit(
             "coordination.barrier_agreed", epoch=epoch, position=agreed,
             host=self.process_index, proposals=len(intents),
+            t_wall=round(time.time(), 6),
         )
         return epoch, agreed
 

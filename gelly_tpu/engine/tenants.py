@@ -641,11 +641,25 @@ class MultiTenantEngine:
         # (no submits) would leave the gauge stuck above low_water and
         # the server's RESUME poll spinning forever.
         self.publish_staged_gauge = False
+        # Optional SLO plane (obs/slo.SloPlane): attached via
+        # attach_slo_plane, ticked from the scheduler loop at the gauge
+        # cadence — per-tenant burn gauges and breach events ride the
+        # same rate limit as the backlog gauges they evaluate.
+        self._slo_plane = None
         self.stats = {"dispatches": 0, "chunks": 0, "windows_closed": 0,
                       "starved_lanes": 0, "reclaims": 0,
                       "lanes_reclaimed": 0}
 
     # ------------------------------------------------------------ control
+
+    def attach_slo_plane(self, plane) -> None:
+        """Attach an :class:`~gelly_tpu.obs.slo.SloPlane`: the
+        scheduler loop ticks it at the backlog-gauge cadence with the
+        engine's live tenant set, so per-tenant burn-rate gauges and
+        breach events stay current without a second evaluation thread
+        (don't also :meth:`~gelly_tpu.obs.slo.SloPlane.start` it)."""
+        with self._lock:
+            self._slo_plane = plane
 
     def add_tier(self, name: str, agg: SummaryAggregation,
                  chunk_capacity: int, min_lanes: int = 1,
@@ -1196,6 +1210,16 @@ class MultiTenantEngine:
                                   round(age, 6))
                     bus.gauge("tenants.backlog_age_max_s",
                               round(backlog_max, 6))
+                    if self._slo_plane is not None:
+                        self._slo_plane.set_tenants(
+                            [tid for tid, _ in tids])
+                        try:
+                            self._slo_plane.tick()
+                        except Exception:
+                            # Evaluation must never take the scheduler
+                            # down with it — a bad spec degrades to a
+                            # logged error, not a stalled dispatch loop.
+                            logger.exception("SLO plane tick failed")
             if hb_due:
                 extras = {}
                 if self.qos is not None:
@@ -1214,6 +1238,8 @@ class MultiTenantEngine:
                     backlog_age_max_s=round(backlog_max, 3),
                     round_p99_ms=round(
                         bus.quantile("tenants.round_ms", 0.99), 3),
+                    slo_breaching=int(bus.gauges.get(
+                        "slo.breaching", 0)),
                     **extras,
                 )
             if advanced:

@@ -37,6 +37,24 @@ The sending half of ``ingest/server.py``'s delivery contract:
   mid-frame (the server drops the already-durable prefix payloads).
   Stacks never mix tenants — each stream key buffers separately.
 
+- **Wire trace propagation** (tracer-gated, zero-cost when no tracer
+  is installed): every DATA/STACKED frame is stamped with a compact
+  trace context (``wire.stamp_trace`` — the tracer's trace_id plus the
+  client-send span id) riding the payload dict, and a ``client_send``
+  span is recorded per frame. The server's recv/staging spans link to
+  it, so one exported trace shows client-send → wire → staging → fold
+  → checkpoint as one causal chain. Because the stamped frame BYTES
+  live in the resend buffer, a retransmitted frame reuses its original
+  trace context by construction — a retry is the same causal event,
+  never a new trace. All K payloads of a STACKED frame stamp the ONE
+  frame-level span allocated when the stack buffer opens.
+- **Push alert subscriptions** (:meth:`subscribe`): register a filter
+  (event-name prefixes, tenant, SLO name) and the server pushes
+  matching EventBus events as ALERT frames — delivered to the
+  ``on_alert`` callback and the bounded :attr:`alerts` deque. Delivery
+  is BEST-EFFORT and outside the exactly-once data seq space: alert
+  seqs are a per-connection counter, never acked, never retransmitted.
+
 A background reader thread (``gelly-ingest-client-rx``) owns every
 incoming frame; protocol state is lock-guarded and ack progress is
 signalled through a condition variable (:meth:`flush` waits on it).
@@ -55,6 +73,7 @@ import numpy as np
 
 from ..engine import faults as faults_mod
 from ..obs import bus as obs_bus
+from ..obs import tracing as obs_tracing
 from . import wire
 
 logger = logging.getLogger("gelly_tpu.ingest")
@@ -159,6 +178,19 @@ class IngestClient:
         self._stats_payload: bytes | None = None
         self._stats_reply_token = 0
         self._stats_token = 0
+        # Push-alert state: SUBSCRIBE confirmations ride the same
+        # correlation-token discipline as STATS; received ALERT frames
+        # land in the bounded ``alerts`` deque and fan out to the
+        # registered handlers (contained — a raising handler must
+        # never kill the reader thread).
+        from collections import deque
+
+        self._sub_evt = threading.Event()
+        self._sub_payload: bytes | None = None
+        self._sub_reply_token = 0
+        self._sub_token = 0
+        self._alert_handlers: list = []
+        self.alerts: "deque[dict]" = deque(maxlen=256)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -327,16 +359,33 @@ class IngestClient:
         if self._stacking:
             return self._send_stacked(key, payload, compressed)
         ftype = wire.DATA_COMPRESSED if compressed else wire.DATA
+        # Wire trace context (tracer-gated; no tracer ⇒ the payload and
+        # frame bytes are exactly what they were before this feature).
+        # Stamping happens at PACK time, so the stamped bytes live in
+        # the resend buffer and a retransmit reuses the original
+        # context by construction — a retry is the same causal event.
+        tracer = obs_tracing.active_tracer()
+        sid = 0
+        t_span = 0.0
         with self._lock:
             self._raise_rx_error_locked()
             self._raise_shed_locked(key)
             seq = self._next.setdefault(key, 0)
+            if tracer is not None:
+                sid = tracer.next_span_id()
+                t_span = tracer.now()
+                payload = wire.stamp_trace(
+                    payload, tracer.trace_id, sid)
             frame = wire.pack_frame(
                 ftype, seq, wire.pack_payload(payload)
             )
             self._unacked[(key, seq)] = (frame, 1)
             self._next[key] = seq + 1
         self._raw_send(frame)
+        if tracer is not None:
+            tracer.span("client_send", "client", t_span, seq=seq,
+                        span=sid, trace=tracer.trace_id,
+                        bytes=len(frame))
         obs_bus.get_bus().inc("ingest.frames_sent")
         return seq
 
@@ -362,14 +411,36 @@ class IngestClient:
         trigger; :meth:`flush` drains any partial tail). Positions are
         assigned AT BUFFER TIME, so the flushed frame's base seq plus
         its payload count exactly tiles the stream's seq space."""
-        blob = wire.pack_payload(payload)
+        # Tracer installed ⇒ packing moves INSIDE the lock: the trace
+        # context every payload stamps is the FRAME-level client-send
+        # span, allocated when its stack buffer opens — and which
+        # buffer a payload joins is only decided under the lock. (No
+        # tracer ⇒ the pack stays outside the lock, unchanged hot
+        # path.)
+        tracer = obs_tracing.active_tracer()
+        blob = wire.pack_payload(payload) if tracer is None else b""
         flush_reason = None
         while True:
             flush_first = False
+            ctx = None
             with self._lock:
                 self._raise_rx_error_locked()
                 self._raise_shed_locked(key)
                 buf = self._stack_buf.get(key)
+                if tracer is not None:
+                    # All K payloads of one STACKED frame link to the
+                    # ONE frame-level span: reuse the open buffer's
+                    # context, or allocate afresh for the stack this
+                    # payload will open. The flush_first loop re-enters
+                    # here, so a payload bumped into a NEW stack by the
+                    # byte ceiling is re-stamped with that stack's own
+                    # context.
+                    if buf is not None and buf[1]:
+                        ctx = buf[4]
+                    else:
+                        ctx = (tracer.next_span_id(), tracer.now())
+                    blob = wire.pack_payload(wire.stamp_trace(
+                        payload, tracer.trace_id, ctx[0]))
                 if buf is not None and buf[1]:
                     # Exact stacked-body bound: count field + one table
                     # entry per payload + the blobs. Appending past
@@ -385,7 +456,7 @@ class IngestClient:
                     self._next[key] = seq + 1
                     if buf is None or not buf[1]:
                         buf = self._stack_buf[key] = [
-                            seq, [], 0, time.monotonic()
+                            seq, [], 0, time.monotonic(), ctx
                         ]
                     buf[1].append((blob, compressed))
                     buf[2] += len(blob)
@@ -414,7 +485,7 @@ class IngestClient:
                 buf = self._stack_buf.pop(key, None)
                 if buf is None or not buf[1] or key in self._shed:
                     return
-                base, parts, nbytes, t0 = buf
+                base, parts, nbytes, t0, ctx = buf
                 if len(parts) == 1:
                     blob, comp = parts[0]
                     ftype = (wire.DATA_COMPRESSED if comp
@@ -444,6 +515,15 @@ class IngestClient:
                     f"send failed ({e}); reconnect() to resume at the "
                     "acked sequence"
                 ) from e
+        if ctx is not None:
+            tracer = obs_tracing.active_tracer()
+            if tracer is not None:
+                # ONE frame-level client-send span for the whole stack
+                # — every stamped payload named this span id as its
+                # parent, so all K link to it in the exported trace.
+                tracer.span("client_send", "client", ctx[1], seq=base,
+                            stack=len(parts), span=ctx[0],
+                            trace=tracer.trace_id, bytes=len(frame))
         bus.inc("ingest.frames_sent")
 
     def _drain_stack_tails(self) -> None:
@@ -560,6 +640,61 @@ class IngestClient:
                 # waiting for OUR token until the deadline.
                 self._stats_evt.clear()
         return json.loads(payload.decode("utf-8"))
+
+    def subscribe(self, *, events=("alerts.", "slo."), tenant=None,
+                  slo: str | None = None, on_alert=None,
+                  timeout: float = 5.0) -> int:
+        """Register a push-alert subscription on the data connection:
+        the server pushes every EventBus event matching the filter as
+        an ALERT frame (decoded dicts land in :attr:`alerts` and fan
+        out to ``on_alert(alert)`` when given). Filter semantics:
+        ``events`` — exact names or dotted prefixes (``"alerts."``
+        matches the whole family); ``tenant`` — only events whose
+        fields name that tenant (events carrying NO tenant field still
+        match — a global breach concerns every subscriber); ``slo`` —
+        only SLO events for that spec name. Returns the server's
+        subscription id.
+
+        Delivery is BEST-EFFORT, explicitly outside the exactly-once
+        data plane: alert seqs are a per-connection counter, never
+        acked, never retransmitted — a dropped alert bumps the
+        server's ``alerts.dropped`` and is gone. Poll :meth:`stats`
+        for the lossless view. The request rides a correlation token
+        in the seq field (echoed on the confirmation), same straggler
+        discipline as :meth:`stats`."""
+        import json
+
+        flt: dict = {"events": [str(e) for e in events]}
+        if tenant is not None:
+            flt["tenant"] = int(tenant)
+        if slo is not None:
+            flt["slo"] = str(slo)
+        with self._lock:
+            if on_alert is not None:
+                self._alert_handlers.append(on_alert)
+            self._sub_token += 1
+            token = self._sub_token
+        self._sub_evt.clear()
+        self._raw_send(wire.pack_frame(
+            wire.SUBSCRIBE, token, wire.pack_json(flt)))
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self._sub_evt.wait(remaining):
+                with self._lock:
+                    self._raise_rx_error_locked()
+                raise IngestError(
+                    f"no SUBSCRIBE confirmation within {timeout}s")
+            with self._lock:
+                if self._sub_reply_token == token:
+                    payload = self._sub_payload
+                    break
+                # A stale straggler — keep waiting for OUR token.
+                self._sub_evt.clear()
+        info = json.loads(payload.decode("utf-8"))
+        if not info.get("ok"):
+            raise IngestError(f"server refused subscription: {info}")
+        return int(info.get("sub_id", 0))
 
     def flush(self, timeout: float = 30.0) -> int:
         """Wait until the server has acked every sent frame (every
@@ -845,6 +980,30 @@ class IngestClient:
                         self._stats_payload = _payload
                         self._stats_reply_token = seq
                     self._stats_evt.set()
+                elif ftype == wire.SUBSCRIBE:
+                    # Server confirmation of a subscribe() request —
+                    # seq echoes our correlation token.
+                    with self._lock:
+                        self._sub_payload = _payload
+                        self._sub_reply_token = seq
+                    self._sub_evt.set()
+                elif ftype == wire.ALERT:
+                    # Best-effort push: record + fan out, contained —
+                    # a raising handler must never kill the reader
+                    # (the ACK/flow-control branches below depend on
+                    # this thread staying alive).
+                    bus.inc("ingest.alerts_received")
+                    alert = _ctl(_payload)
+                    self.alerts.append(alert)
+                    with self._lock:
+                        handlers = list(self._alert_handlers)
+                    for fn in handlers:
+                        try:
+                            fn(alert)
+                        except Exception:  # noqa: BLE001
+                            logger.exception(
+                                "alert handler failed on %r",
+                                alert.get("event"))
                 elif ftype == wire.BYE:
                     return
         finally:

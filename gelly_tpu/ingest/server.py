@@ -87,7 +87,34 @@ Delivery contract:
   server additionally records the ``ingest.receive_to_stage_ms``
   histogram and stamps each staged frame's ingress time into
   ``bus.watermarks`` (stream key ``"stream"``), the source of the
-  end-to-end latency watermarks downstream consumers retire.
+  end-to-end latency watermarks downstream consumers retire. A STATS
+  request whose payload is ``{"format": "prometheus"}`` is answered
+  with the Prometheus text-format exposition of every bus
+  counter/gauge/histogram instead of JSON (``obs/slo.py``'s
+  ``prometheus_text``).
+- **Wire trace propagation.** With a span tracer installed, each
+  admitted frame's payload may carry a compact trace context
+  (``wire.TRACE_KEY`` — the client's trace_id + client-send span id;
+  stamped by ``IngestClient``, absent on legacy senders). The server
+  POPS it before the payload reaches any chunk builder or codec, and
+  records a ``wire_recv`` span (frame fully received → payload
+  decoded, parented on the client-send span) plus a ``staging`` span
+  (admission wait → enqueued, parented on wire_recv) per admitted
+  unit; the staged positions are bound to the staging span's context
+  in the tracer's position registry, so the engine's fold/checkpoint
+  spans downstream link to the same trace — one causal chain
+  client-send → wire → staging → fold → durable checkpoint.
+- **Push alert subscriptions (SUBSCRIBE/ALERT).** A SUBSCRIBE frame
+  registers an EventBus subscription scoped to this connection: every
+  bus event matching the JSON filter (event-name prefixes, tenant,
+  SLO name) is pushed as an ALERT frame. Delivery is BEST-EFFORT and
+  explicitly OUTSIDE the exactly-once data plane: ALERT seqs are a
+  per-connection counter (never stream positions), alerts are never
+  buffered for retransmission and never acked; a failed send bumps
+  ``alerts.dropped`` and moves on. The subscription dies with the
+  connection. ``analysis/contracts.py`` rule AL001 enforces the
+  separation: an ALERT-sending scope must not touch seq/ack state or
+  the resend buffer.
 """
 
 from __future__ import annotations
@@ -110,6 +137,74 @@ from . import wire
 logger = logging.getLogger("gelly_tpu.ingest")
 
 _DONE = object()
+
+
+def _trace_recv(tracer, t_rx: float, tctx, seq: int, nbytes: int,
+                **extra) -> int:
+    """Record one admitted frame's ``wire_recv`` span (frame fully
+    received → payload decoded), parented on the client-send span when
+    the frame carried a trace context; returns the span id the
+    ``staging`` span parents on."""
+    sid = tracer.next_span_id()
+    args = {"seq": seq, "bytes": nbytes, "span": sid}
+    if tctx is not None:
+        args["trace"], args["parent"] = tctx
+    args.update(extra)
+    tracer.span("wire_recv", "ingest", t_rx - tracer.t0, **args)
+    return sid
+
+
+def _trace_staged(tracer, t0: float, rx_sid: int, tctx, keys, seq: int,
+                  depth: int, **extra) -> None:
+    """Record one staged unit's ``staging`` span (admission wait →
+    enqueued) and bind every covered position to its context, so the
+    engine's fold/checkpoint spans can link to the same trace by
+    position. A context-less (legacy) frame still gets a span and a
+    binding under the server tracer's own trace id — the server-side
+    chain stays linked even when the client stamps nothing."""
+    sid = tracer.next_span_id()
+    trace = tctx[0] if tctx is not None else tracer.trace_id
+    tracer.span("staging", "ingest", t0, seq=seq, span=sid,
+                parent=rx_sid, trace=trace, depth=depth, **extra)
+    for k in keys:
+        tracer.bind_ctx(k, trace, sid)
+
+
+def _json_safe(fields: dict) -> dict:
+    """Alert fields as plain JSON types: an EventBus event may carry
+    arrays/objects, and a malformed alert payload must never break the
+    wire framing (``pack_json`` has no fallback encoder)."""
+    out = {}
+    for k, v in fields.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[str(k)] = v
+        else:
+            out[str(k)] = repr(v)[:200]
+    return out
+
+
+def _alert_match(events, tenant, slo, name: str, fields: dict) -> bool:
+    """One subscription filter against one bus event. ``events`` are
+    exact names or dotted prefixes (``"alerts."``); a tenant filter
+    passes events that carry NO tenant field (a global breach concerns
+    every subscriber) and blocks other tenants' events; an SLO filter
+    matches the event's ``slo`` field."""
+    if events and not any(
+        name == e or (e.endswith(".") and name.startswith(e))
+        for e in events
+    ):
+        return False
+    if tenant is not None:
+        ev_tenant = fields.get("tenant")
+        if ev_tenant is not None:
+            try:
+                if int(ev_tenant) != int(tenant):
+                    return False
+            except (TypeError, ValueError):
+                return False
+    if slo is not None and fields.get("slo") != slo:
+        return False
+    return True
 
 
 def payload_to_chunk(payload: dict, capacity: int,
@@ -232,6 +327,11 @@ class IngestServer:
         self._next_seq = int(resume_seq)
         self._acked = int(resume_seq)
         self._durable = int(resume_seq)
+        # Push-alert subscriptions: ids are server-unique; the live
+        # count feeds the ``alerts.subscribers`` gauge. Both under
+        # _state_lock (subscribe/teardown are control-plane rare).
+        self._next_sub_id = 0
+        self._alert_subscribers = 0
         self._conn_sock: socket.socket | None = None
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
@@ -612,6 +712,16 @@ class IngestServer:
         # connections may only HELLO (challenge/proof) or BYE.
         authed = self.auth_token is None
         nonce: bytes | None = None
+        # Push-alert state (per connection): bus unsubscribe callables
+        # (fired at teardown — a dead connection must not keep a
+        # subscriber pushing into a closed socket forever) and the
+        # alert seq counter — its OWN space, never stream state.
+        # itertools.count: next() is GIL-atomic, so concurrent bus
+        # emitters allocate alert seqs without a lock.
+        import itertools
+
+        alert_subs: list = []
+        alert_seq = itertools.count(1)
         try:
             while not self._stop.is_set():
                 try:
@@ -666,8 +776,18 @@ class IngestServer:
                 if ftype == wire.STATS:
                     # Read-only introspection, answerable mid-stream:
                     # touches neither the expected seq nor the ack
-                    # state, and never adopts this connection.
-                    self._answer_stats(sock, bus, seq)
+                    # state, and never adopts this connection. The
+                    # request payload selects the exposition format
+                    # (JSON default; {"format": "prometheus"} for the
+                    # text exposition).
+                    self._answer_stats(sock, bus, seq, payload)
+                    continue
+                if ftype == wire.SUBSCRIBE:
+                    # Push-alert registration: like STATS, read-only
+                    # control — never adopts the connection, never
+                    # touches seq/ack state (AL001).
+                    self._answer_subscribe(sock, bus, seq, payload,
+                                           alert_subs, alert_seq)
                     continue
                 if ftype == wire.HELLO:
                     if not authed:
@@ -755,6 +875,16 @@ class IngestServer:
                     logger.warning("malformed payload seq=%d: %s", seq, e)
                     self._send(sock, wire.pack_frame(wire.REJECT, expect))
                     continue
+                # Pop the wire trace context BEFORE the payload reaches
+                # any consumer (it is transport metadata, not stream
+                # data — chunk builders and codecs must never see it).
+                tctx = wire.pop_trace(data)
+                rx_sid = 0
+                t_stage = 0.0
+                if tracer is not None:
+                    rx_sid = _trace_recv(tracer, t_rx, tctx, seq,
+                                         len(payload))
+                    t_stage = tracer.now()
                 # Admission control sits HERE — at the staging boundary,
                 # after control frames (so a handshake always completes
                 # even under full backpressure) and before the enqueue
@@ -792,6 +922,8 @@ class IngestServer:
                     bus.inc("ingest.data_frames_raw")
                 bus.gauge("ingest.staged_depth", self._q.qsize())
                 if tracer is not None:
+                    _trace_staged(tracer, t_stage, rx_sid, tctx, (seq,),
+                                  seq, self._q.qsize())
                     tracer.instant("ingest.chunk_staged", track="ingest",
                                    seq=seq, bytes=len(payload))
                 pending_acks[0] += 1
@@ -800,6 +932,17 @@ class IngestServer:
                     self._send(sock, wire.pack_frame(wire.ACK, acked))
                     bus.inc("ingest.acks_sent")
         finally:
+            # Tear down this connection's alert subscriptions BEFORE
+            # closing the socket state: a subscriber firing after this
+            # point would only count alerts.dropped against a socket
+            # that can never deliver again.
+            if alert_subs:
+                for unsub in alert_subs:
+                    unsub()
+                with self._state_lock:
+                    self._alert_subscribers -= len(alert_subs)
+                    n_subs = self._alert_subscribers
+                bus.gauge("alerts.subscribers", n_subs)
             _close_quietly(sock)
             with self._state_lock:
                 if self._conn_sock is sock:
@@ -845,6 +988,7 @@ class IngestServer:
             self._send(sock, wire.pack_frame(
                 wire.REJECT, 0, wire.pack_json({"resync": True})))
             return True
+        tctx = wire.pop_trace(data)
         wt = data.get("tenant")
         if wt is None:
             bus.inc("ingest.chunks_unroutable")
@@ -887,6 +1031,12 @@ class IngestServer:
             # as the legacy path's stamp site.
             with self._state_lock:
                 bus.watermarks.stamp(self.wire_ledger(tid), seq)
+        rx_sid = 0
+        t_stage = 0.0
+        if tracer is not None:
+            rx_sid = _trace_recv(tracer, t_rx, tctx, seq, len(payload),
+                                 tenant=tid)
+            t_stage = tracer.now()
         self._apply_backpressure(sock, bus)
         if not self._enqueue((seq, data, compressed)):
             return False
@@ -906,6 +1056,9 @@ class IngestServer:
             bus.inc("ingest.data_frames_raw")
         bus.gauge("ingest.staged_depth", self._q.qsize())
         if tracer is not None:
+            _trace_staged(tracer, t_stage, rx_sid, tctx,
+                          (("t", tid, seq),), seq, self._q.qsize(),
+                          tenant=tid)
             tracer.instant("ingest.chunk_staged", track="ingest",
                            seq=seq, tenant=tid, bytes=len(payload))
         if self.auto_ack:
@@ -957,6 +1110,12 @@ class IngestServer:
             self._send(sock, reject)
             return True
         flags = [c for _b, c in parts]
+        # Pop every payload's wire trace context before any of them
+        # reach a consumer. All K payloads of one stacked frame carry
+        # the SAME frame-level client-send context (the client stamps
+        # the stack's one span id), so the first surviving context
+        # after the prefix drop is THE frame's context.
+        tctxs = [wire.pop_trace(d) for d in datas]
         k = len(datas)
         env = b""
         tid = None
@@ -1018,6 +1177,7 @@ class IngestServer:
             )
         datas = datas[drop:]
         flags = flags[drop:]
+        tctx = next((c for c in tctxs[drop:] if c is not None), None)
         stage_seq = expect
         if telemetry:
             # Ingress stamp BEFORE the admission wait, under the state
@@ -1029,6 +1189,12 @@ class IngestServer:
                        else self.watermark_stream)
                 for j in range(len(datas)):
                     bus.watermarks.stamp(led, stage_seq + j)
+        rx_sid = 0
+        t_stage = 0.0
+        if tracer is not None:
+            rx_sid = _trace_recv(tracer, t_rx, tctx, seq, len(payload),
+                                 stack=k)
+            t_stage = tracer.now()
         self._apply_backpressure(sock, bus)
         if not self._enqueue((stage_seq, datas, flags)):
             return False
@@ -1052,6 +1218,16 @@ class IngestServer:
                         (time.perf_counter() - t_rx) * 1e3)
         bus.gauge("ingest.staged_depth", self._q.qsize())
         if tracer is not None:
+            # ONE staging span covers the whole admitted stack; every
+            # covered position binds to it (all K payloads link to the
+            # one frame-level chain).
+            if tid is not None:
+                keys = [("t", tid, stage_seq + j)
+                        for j in range(len(datas))]
+            else:
+                keys = list(range(stage_seq, stage_seq + len(datas)))
+            _trace_staged(tracer, t_stage, rx_sid, tctx, keys,
+                          stage_seq, self._q.qsize(), stack=k)
             tracer.instant("ingest.chunk_staged", track="ingest",
                            seq=stage_seq, stack=k, bytes=len(payload))
         if self.auto_ack:
@@ -1062,12 +1238,16 @@ class IngestServer:
             bus.inc("ingest.acks_sent")
         return True
 
-    def _answer_stats(self, sock, bus, seq: int = 0) -> None:
+    def _answer_stats(self, sock, bus, seq: int = 0,
+                      req: bytes = b"") -> None:
         """Reply to one STATS frame: a JSON snapshot of the current bus
         (counters/gauges/histogram quantiles/watermarks/host identity)
         plus the server's own sequencing view and any ``stats_fields``
-        extras. The request's ``seq`` is echoed on the reply — it is a
-        client-side correlation token (never stream state), letting
+        extras — or, when the request payload is ``{"format":
+        "prometheus"}``, the Prometheus text-format exposition of every
+        bus counter/gauge/histogram (``obs/slo.prometheus_text``). The
+        request's ``seq`` is echoed on the reply — it is a client-side
+        correlation token (never stream state), letting
         ``IngestClient.stats()`` reject a straggler reply to an earlier
         timed-out request. Failures are contained — introspection must
         never take the stream down."""
@@ -1076,6 +1256,22 @@ class IngestServer:
         from ..obs.status import build_stats
 
         bus.inc("ingest.stats_requests")
+        fmt = "json"
+        if req:
+            try:
+                fmt = str(wire.unpack_json(req).get("format", "json"))
+            except wire.FrameError:
+                fmt = "json"  # legacy/garbled request: JSON reply
+        if fmt == "prometheus":
+            from ..obs.slo import prometheus_text
+
+            try:
+                body = prometheus_text(bus).encode("utf-8")
+            except Exception as e:  # noqa: BLE001
+                body = (f"# exposition error: {type(e).__name__}: "
+                        f"{e}"[:200] + "\n").encode("utf-8")
+            self._send(sock, wire.pack_frame(wire.STATS, seq, body))
+            return
         extra: dict = {}
         if self.stats_fields is not None:
             try:
@@ -1111,6 +1307,64 @@ class IngestServer:
                 {"error": f"{type(e).__name__}: {e}"[:200]}
             ).encode("utf-8")
         self._send(sock, wire.pack_frame(wire.STATS, seq, body))
+
+    def _answer_subscribe(self, sock, bus, seq: int, payload: bytes,
+                          subs: list, alert_seq) -> None:
+        """Register one push-alert subscription for this connection
+        and confirm it (SUBSCRIBE echo carrying the correlation token
+        and the subscription id). The registered bus subscriber pushes
+        every matching event as an ALERT frame — BEST-EFFORT by
+        contract: the alert seq is ``next(alert_seq)`` (a
+        per-connection counter, its own space), nothing is buffered
+        for retransmission, nothing is acked, and a send failure only
+        counts ``alerts.dropped``. The data plane's exactly-once state
+        is untouched (AL001)."""
+        flt: dict | None = {}
+        if payload:
+            try:
+                flt = wire.unpack_json(payload)
+            except wire.FrameError:
+                flt = None
+        if flt is None or not isinstance(flt.get("events", []), list):
+            self._send(sock, wire.pack_frame(
+                wire.SUBSCRIBE, seq,
+                wire.pack_json({"ok": False,
+                                "error": "malformed filter"})))
+            return
+        events = [str(e) for e in flt.get("events", [])]
+        tenant = flt.get("tenant")
+        slo = flt.get("slo")
+        with self._state_lock:
+            self._next_sub_id += 1
+            sub_id = self._next_sub_id
+            self._alert_subscribers += 1
+            n_subs = self._alert_subscribers
+        bus.inc("alerts.subscriptions")
+        bus.gauge("alerts.subscribers", n_subs)
+
+        def push_alert(name: str, fields: dict) -> None:
+            if not _alert_match(events, tenant, slo, name, fields):
+                return
+            body = wire.pack_json({
+                "event": name, "sub_id": sub_id,
+                "fields": _json_safe(fields),
+            })
+            frame = wire.pack_frame(wire.ALERT, next(alert_seq), body)
+            if self._send(sock, frame):
+                bus.inc("alerts.pushed")
+            else:
+                # Best-effort: a closed/blocked socket drops the alert
+                # — the conn loop's teardown unsubscribes shortly.
+                bus.inc("alerts.dropped")
+
+        subs.append(bus.subscribe(push_alert))
+        logger.info(
+            "alert subscription %d registered (events=%s tenant=%s "
+            "slo=%s)", sub_id, events or "all", tenant, slo,
+        )
+        self._send(sock, wire.pack_frame(
+            wire.SUBSCRIBE, seq,
+            wire.pack_json({"ok": True, "sub_id": sub_id})))
 
     def _enqueue(self, item) -> bool:
         import queue as queue_mod

@@ -13,7 +13,7 @@ Frame layout (network byte order)::
     magic  u16   0x4749 ("GI")
     type   u8    HELLO/WELCOME/DATA/ACK/REJECT/PAUSE/RESUME/BYE/
                  DATA_COMPRESSED/STATS/NACK/AUTH_CHALLENGE/AUTH_FAIL/
-                 STACKED
+                 STACKED/SUBSCRIBE/ALERT
     flags  u8    reserved (0)
     seq    u64   per-stream sequence number (DATA/DATA_COMPRESSED: the
                  chunk position; STACKED: the FIRST stacked payload's
@@ -102,10 +102,26 @@ AUTH_FAIL = 13
 # durable prefix payloads dropped. Each payload's kind byte marks it
 # raw (DATA semantics) or pre-compressed (DATA_COMPRESSED semantics).
 STACKED = 14
+# Push-alert registration (client -> server): the payload is a JSON
+# filter — ``{"events": [name-or-prefix, ...], "tenant": int|null,
+# "slo": str|null}`` — selecting which EventBus events this connection
+# wants pushed as ALERT frames (component merges, degree spikes, SLO
+# breaches). The request's seq is a client-side correlation token
+# (never stream state) echoed on the server's SUBSCRIBE confirmation
+# reply (``{"ok": true, "sub_id": n}``), same discipline as STATS.
+SUBSCRIBE = 15
+# Push alert (server -> client): one matched EventBus event, payload
+# ``{"event": name, "fields": {...}}``. Delivery is BEST-EFFORT and
+# explicitly OUTSIDE the exactly-once data plane: the frame's seq is a
+# per-connection alert counter (never a stream position), alerts are
+# never buffered for retransmission, never acked, and a send failure
+# only bumps ``alerts.dropped`` — a client that needs a lossless view
+# polls STATS; alerts are the low-latency nudge, not the ledger.
+ALERT = 16
 
 FRAME_TYPES = (HELLO, WELCOME, DATA, ACK, REJECT, PAUSE, RESUME, BYE,
                DATA_COMPRESSED, STATS, NACK, AUTH_CHALLENGE, AUTH_FAIL,
-               STACKED)
+               STACKED, SUBSCRIBE, ALERT)
 
 # Bound on a single payload (64 MiB): a length prefix beyond it is
 # treated as a corrupt header, not an allocation request.
@@ -268,6 +284,49 @@ def unpack_payload(buf: bytes) -> dict:
             f"{len(view) - pos} trailing bytes after the last array"
         )
     return out
+
+
+# --------------------------------------------------------------------- #
+# wire trace context: a compact (trace_id, parent span id) pair riding
+# the self-describing payload dict
+
+# The reserved payload key the context rides under. It is an ordinary
+# payload array (u64[2] = [trace_id, span_id]), so the frame format is
+# UNCHANGED — legacy receivers that never pop it would just see one
+# extra array, and legacy senders' frames (no such key) remain valid.
+# Receivers must pop_trace() BEFORE handing the payload to a chunk
+# builder or codec (the key is transport metadata, not stream data).
+TRACE_KEY = "_trace"
+
+
+def stamp_trace(payload: dict, trace_id_hex: str, span_id: int) -> dict:
+    """Return a COPY of ``payload`` carrying the wire trace context
+    (the caller's dict is never mutated — it may be a caller-owned
+    template). ``trace_id_hex`` is the tracer's 16-hex-char id;
+    ``span_id`` is the sending span the receiver's spans parent on."""
+    out = dict(payload)
+    out[TRACE_KEY] = np.array(
+        [int(trace_id_hex, 16), int(span_id)], dtype=np.uint64
+    )
+    return out
+
+
+def pop_trace(data: dict) -> tuple[str, int] | None:
+    """Remove and decode the wire trace context from an unpacked
+    payload dict (in place). Returns ``(trace_id_hex, parent_span_id)``
+    or None when the sender stamped nothing (legacy frames) or the
+    entry is malformed — a bad stamp must never reject a CRC-valid
+    data frame, so malformed decodes degrade to unlinked, silently."""
+    arr = data.pop(TRACE_KEY, None)
+    if arr is None:
+        return None
+    try:
+        flat = np.asarray(arr, dtype=np.uint64).reshape(-1)
+        if flat.shape[0] != 2:
+            return None
+        return format(int(flat[0]), "016x"), int(flat[1])
+    except (TypeError, ValueError, OverflowError):
+        return None
 
 
 # --------------------------------------------------------------------- #

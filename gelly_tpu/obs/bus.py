@@ -184,6 +184,38 @@ Counter/gauge names are dotted, ``<subsystem>.<what>``:
 ``windows.live_slots``                compact-id slots assigned after
                                       the pane's TTL sweep (gauge — the
                                       bounded steady-state capacity)
+``slo.breaching``                     SLO instances currently in breach
+                                      (gauge; the heartbeat's
+                                      ``slo_breaching=`` source)
+``slo.fold_p99_ms.burn_rate``         breaching fraction of the spec's
+                                      rolling window, 0..1 (gauge; one
+                                      ``slo.<key>.burn_rate`` per spec
+                                      instance, ``<key>`` suffixed
+                                      ``.t<tid>`` for per-tenant SLOs)
+``slo.breach``                        healthy→breach crossings (event;
+                                      fields ``slo``/``tenant``/
+                                      ``value``/``threshold``/
+                                      ``burn_rate`` — the push-alert
+                                      and QoS admission signal)
+``slo.recovered``                     breach→healthy crossings (event,
+                                      same fields)
+``alerts.component_merge``            summary-delta watch saw the
+                                      component count drop — a merge
+                                      happened (event)
+``alerts.degree_spike``               max degree jumped past
+                                      ``spike_factor`` × its trailing
+                                      EMA (event)
+``alerts.subscriptions``              SUBSCRIBE filters accepted,
+                                      cumulative
+``alerts.subscribers``                live alert subscriptions across
+                                      all connections (gauge)
+``alerts.pushed``                     ALERT frames written to
+                                      subscribed clients
+``alerts.dropped``                    ALERT frames lost to a dead
+                                      connection — the best-effort
+                                      delivery contract's loss counter
+``ingest.alerts_received``            ALERT frames consumed by a
+                                      client's reader loop
 ====================================  =================================
 
 Histogram names (``bus.observe(name, value_ms)`` — latency

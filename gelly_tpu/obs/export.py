@@ -24,6 +24,15 @@ so the two timelines can be opened side by side and matched.
 
 :func:`validate_chrome_trace` is the schema check the tests and the
 bench artifact path share — load-bearing validation, not a smoke print.
+
+Multi-host stitching: each host of a coordinated run exports its own
+trace file (one ring per process; ``otherData.host`` carries the
+``process_index`` identity). :func:`stitch_traces` merges them into a
+single timeline — one ``pid`` per host, clocks aligned on the first
+``coordination.barrier_agreed`` instant every host recorded (matched by
+its ``epoch`` arg), and Perfetto flow arrows (``"s"``/``"f"`` phase
+pairs sharing an ``id``) synthesized at every shared barrier so the
+viewer draws the cross-host hand-off explicitly.
 """
 
 from __future__ import annotations
@@ -107,8 +116,9 @@ def validate_chrome_trace(trace: dict) -> None:
     JSON (object format): JSON-serializable, ``traceEvents`` a list of
     events each carrying ``name``/``ph``/``pid``/``tid``, numeric ``ts``
     on non-metadata phases, numeric non-negative ``dur`` on ``"X"``
-    spans, and every referenced ``tid`` named by a ``thread_name``
-    metadata event."""
+    spans, flow events (``"s"``/``"f"``) carrying an ``id`` (and
+    ``"bp": "e"`` on the finish side), and every referenced
+    ``(pid, tid)`` named by a ``thread_name`` metadata event."""
     if not isinstance(trace, dict):
         raise ValueError(f"trace must be a dict, got {type(trace).__name__}")
     events = trace.get("traceEvents")
@@ -129,7 +139,7 @@ def validate_chrome_trace(trace: dict) -> None:
         ph = ev["ph"]
         if ph == "M":
             if ev["name"] == "thread_name":
-                named_tids.add(ev["tid"])
+                named_tids.add((ev["pid"], ev["tid"]))
             continue
         if not isinstance(ev.get("ts"), (int, float)):
             raise ValueError(f"event #{i} ({ev['name']}): ts must be numeric")
@@ -144,9 +154,136 @@ def validate_chrome_trace(trace: dict) -> None:
                 raise ValueError(
                     f"event #{i} ({ev['name']}): instant needs scope "
                     "'s' in g/p/t")
+        elif ph in ("s", "f"):
+            if "id" not in ev:
+                raise ValueError(
+                    f"event #{i} ({ev['name']}): flow event needs an 'id'")
+            if ph == "f" and ev.get("bp") != "e":
+                raise ValueError(
+                    f"event #{i} ({ev['name']}): flow finish needs "
+                    "'bp': 'e' to bind at the enclosing slice")
         else:
             raise ValueError(f"event #{i}: unexpected phase {ph!r}")
-        if ev["tid"] != 0 and ev["tid"] not in named_tids:
+        if ev["tid"] != 0 and (ev["pid"], ev["tid"]) not in named_tids:
             raise ValueError(
                 f"event #{i} ({ev['name']}): tid {ev['tid']} has no "
                 "thread_name metadata (track unnamed in the viewer)")
+
+
+def _load_trace(t) -> dict:
+    if isinstance(t, dict):
+        return t
+    with open(t) as f:
+        return json.load(f)
+
+
+def stitch_traces(traces, out_path: str | None = None,
+                  barrier_name: str = "coordination.barrier_agreed") -> dict:
+    """Merge per-host Chrome traces into one multi-process timeline.
+
+    ``traces`` is a sequence of trace dicts or file paths (one per
+    host, as written by :func:`write_chrome_trace`). Each host becomes
+    its own ``pid`` (``process_index + 1``; enumeration order when a
+    trace carries no host identity), keeping every per-host track lane
+    intact. Host clocks are monotonic-from-different-epochs, so they
+    are aligned on the first ``barrier_name`` instant **every** host
+    recorded (matched by its ``epoch`` arg — the agreement instant is
+    the one event all hosts log for the same logical moment); hosts
+    missing a shared barrier merge unaligned with offset 0. At every
+    shared barrier epoch a Perfetto flow arrow (``"s"`` on the
+    reference host, ``"f"``/``"bp": "e"`` on each other host, shared
+    ``id``) is synthesized so the cross-host hand-off draws explicitly.
+
+    Validates the stitched trace, optionally writes it to
+    ``out_path``, and returns it.
+    """
+    loaded = [_load_trace(t) for t in traces]
+    if not loaded:
+        raise ValueError("stitch_traces needs at least one trace")
+    hosts: list[tuple[int, dict]] = []
+    for i, tr in enumerate(loaded):
+        other = tr.get("otherData") or {}
+        hinfo = other.get("host") or {}
+        idx = hinfo.get("process_index")
+        hosts.append((idx if isinstance(idx, int) else i, tr))
+    hosts.sort(key=lambda p: p[0])
+
+    def _barriers(tr: dict) -> dict:
+        out: dict = {}
+        for ev in tr.get("traceEvents", []):
+            if ev.get("ph") == "i" and ev.get("name") == barrier_name:
+                ep = (ev.get("args") or {}).get("epoch")
+                if ep is not None and ep not in out:
+                    out[ep] = ev
+        return out
+
+    per_host = [_barriers(tr) for _, tr in hosts]
+    common = set(per_host[0])
+    for b in per_host[1:]:
+        common &= set(b)
+    # Align on the FIRST shared barrier: offsets shift every host's
+    # timeline so that instant lands at the reference host's timestamp.
+    offsets: list[float] = []
+    for b in per_host:
+        if common:
+            ep0 = min(common)
+            offsets.append(per_host[0][ep0]["ts"] - b[ep0]["ts"])
+        else:
+            offsets.append(0.0)
+
+    events: list[dict] = []
+    host_meta: dict[str, dict] = {}
+    for (hidx, tr), off in zip(hosts, offsets):
+        pid = hidx + 1
+        other = tr.get("otherData") or {}
+        host_meta[str(pid)] = {
+            "trace_id": other.get("trace_id"),
+            "host": other.get("host") or {},
+            "clock_offset_us": round(off, 3),
+        }
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": f"host{hidx}:{other.get('trace_id', '')}"},
+        })
+        for ev in tr.get("traceEvents", []):
+            ev = dict(ev)
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # replaced by the per-host name above
+            ev["pid"] = pid
+            if ev.get("ph") != "M":
+                ev["ts"] = round(ev["ts"] + off, 3)
+            events.append(ev)
+
+    ref_pid = hosts[0][0] + 1
+    for ep in sorted(common):
+        ref_ev = per_host[0][ep]
+        fid = f"barrier-{ep}"
+        events.append({
+            "ph": "s", "name": "barrier_flow", "cat": "gelly", "id": fid,
+            "ts": round(ref_ev["ts"] + offsets[0], 3),
+            "pid": ref_pid, "tid": ref_ev["tid"],
+        })
+        for slot in range(1, len(hosts)):
+            bev = per_host[slot][ep]
+            events.append({
+                "ph": "f", "bp": "e", "name": "barrier_flow",
+                "cat": "gelly", "id": fid,
+                "ts": round(bev["ts"] + offsets[slot], 3),
+                "pid": hosts[slot][0] + 1, "tid": bev["tid"],
+            })
+
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "stitched_hosts": len(hosts),
+            "hosts": host_meta,
+            "barrier_epochs": sorted(common),
+        },
+    }
+    validate_chrome_trace(trace)
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(trace, f, indent=1)
+            f.write("\n")
+    return trace

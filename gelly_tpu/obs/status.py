@@ -48,17 +48,24 @@ def build_stats(bus=None, extra: dict | None = None) -> dict:
     return out
 
 
-def fetch_stats(host: str, port: int, timeout: float = 5.0) -> dict:
+def fetch_stats(host: str, port: int, timeout: float = 5.0,
+                fmt: str = "json"):
     """Ask a live ingest server for its STATS snapshot over a DEDICATED
     connection (the server never adopts a stats-only connection as the
     data stream, so an in-flight DATA stream is untouched). Returns the
-    decoded JSON dict."""
+    decoded JSON dict, or — with ``fmt="prometheus"`` — the raw
+    Prometheus text exposition rendered by
+    :func:`gelly_tpu.obs.slo.prometheus_text` (a scrape bridge pipes
+    this straight into a textfile collector)."""
     from ..ingest import wire
 
+    if fmt not in ("json", "prometheus"):
+        raise ValueError(f"fmt must be 'json' or 'prometheus', got {fmt!r}")
+    req = b"" if fmt == "json" else wire.pack_json({"format": fmt})
     deadline = time.monotonic() + timeout
     with socket.create_connection((host, port), timeout=timeout) as sock:
         sock.settimeout(0.2)
-        sock.sendall(wire.pack_frame(wire.STATS, 0))
+        sock.sendall(wire.pack_frame(wire.STATS, 0, req))
 
         def recv(n: int) -> bytes:
             while True:
@@ -75,7 +82,8 @@ def fetch_stats(host: str, port: int, timeout: float = 5.0) -> dict:
         while True:
             ftype, _seq, payload = wire.read_frame(recv)
             if ftype == wire.STATS:
-                return json.loads(payload.decode("utf-8"))
+                text = payload.decode("utf-8")
+                return text if fmt == "prometheus" else json.loads(text)
             if ftype == wire.BYE:
                 raise ConnectionError(
                     f"{host}:{port} closed before answering STATS"
@@ -85,18 +93,26 @@ def fetch_stats(host: str, port: int, timeout: float = 5.0) -> dict:
 
 
 def main(argv) -> int:
-    if len(argv) != 1 or ":" not in argv[0]:
-        print("usage: python -m gelly_tpu.obs.status HOST:PORT",
-              file=sys.stderr)
+    args = list(argv)
+    fmt = "json"
+    if "--prometheus" in args:
+        args.remove("--prometheus")
+        fmt = "prometheus"
+    if len(args) != 1 or ":" not in args[0]:
+        print("usage: python -m gelly_tpu.obs.status [--prometheus] "
+              "HOST:PORT", file=sys.stderr)
         return 2
-    host, port = argv[0].rsplit(":", 1)
+    host, port = args[0].rsplit(":", 1)
     try:
-        stats = fetch_stats(host, int(port))
+        stats = fetch_stats(host, int(port), fmt=fmt)
     except (OSError, TimeoutError, ValueError) as e:
         print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
-    json.dump(stats, sys.stdout, indent=2, sort_keys=True, default=str)
-    print()
+    if fmt == "prometheus":
+        sys.stdout.write(stats)
+    else:
+        json.dump(stats, sys.stdout, indent=2, sort_keys=True, default=str)
+        print()
     return 0
 
 

@@ -20,6 +20,19 @@ the consumer concurrently; ``deque.append`` is atomic under the GIL and
 the record is fully built before the append, so no lock is needed on
 the hot path.
 
+**Wire trace propagation** (ISSUE 20): the tracer also owns the two
+pieces the causal chain across the wire needs — a monotonic span-id
+allocator (:meth:`SpanTracer.next_span_id`; ids are per-tracer, stamped
+into span ``args`` as ``span=``/``parent=`` so an exported trace links
+client-send → wire recv → staging → fold → checkpoint), and a BOUNDED
+position→context registry (:meth:`bind_ctx` / :meth:`ctx`): the ingest
+server binds each staged chunk position to its staging span's context,
+and the engine's fold/checkpoint sites look the context up by position
+to parent their spans on it. The registry is a plain dict plus an
+insertion-order eviction deque capped at :data:`CTX_CAPACITY` entries —
+a long stream cannot grow it, and an evicted position simply yields an
+unlinked (but still recorded) span.
+
 **Flight recorder** (rotating-segment mode): construct with
 ``SpanTracer(segment_s=K, segments=N)`` and the ring becomes a bounded
 ring of N TIME segments — the newest ``N * K`` seconds of spans are
@@ -41,6 +54,13 @@ import os
 import threading
 import time
 from typing import Iterator
+
+# Bound on the position→trace-context registry (bind_ctx/ctx): oldest
+# bindings evict first. 4096 positions is far past any staging queue +
+# in-flight fold window, so a linked span only loses its parent when
+# the pipeline is tens of thousands of chunks behind — at which point
+# backlog, not trace linkage, is the story.
+CTX_CAPACITY = 4096
 
 
 class SpanTracer:
@@ -91,6 +111,15 @@ class SpanTracer:
         self._cur: list = []
         self._seg_start = 0.0
         self.dumps: list = []  # flight-dump paths, newest last
+        # Wire-propagation state: the span-id allocator (itertools.count
+        # — next() on it is GIL-atomic, so concurrent stages allocate
+        # without a lock) and the bounded position→context registry.
+        import itertools
+
+        self._span_ids = itertools.count(1)
+        self._ctx: dict = {}
+        self._ctx_order: "deque" = deque()
+        self._ctx_lock = threading.Lock()
 
     # ------------------------------------------------------------ hot path
 
@@ -144,6 +173,34 @@ class SpanTracer:
             "thread": threading.current_thread().name,
             "args": attrs,
         })
+
+    # ------------------------------------------------- wire trace context
+
+    def next_span_id(self) -> int:
+        """Allocate a span id for cross-span linkage (stamped into span
+        ``args`` as ``span=``; children record it as ``parent=``). Ids
+        are unique per tracer and never reused."""
+        return next(self._span_ids)
+
+    def bind_ctx(self, key, trace: str, span: int) -> None:
+        """Bind ``key`` (a chunk position, or any hashable stage key)
+        to a trace context ``(trace_id_hex, span_id)`` so a later stage
+        that only knows the position can parent its span on it. The
+        registry holds at most :data:`CTX_CAPACITY` bindings — oldest
+        evict first, so a stalled consumer can never grow it."""
+        with self._ctx_lock:
+            if key not in self._ctx:
+                self._ctx_order.append(key)
+                while len(self._ctx_order) > CTX_CAPACITY:
+                    self._ctx.pop(self._ctx_order.popleft(), None)
+            self._ctx[key] = (trace, span)
+
+    def ctx(self, key) -> tuple[str, int] | None:
+        """The bound ``(trace_id_hex, span_id)`` for ``key``, or None
+        (never bound, or evicted — the caller records an unlinked
+        span)."""
+        with self._ctx_lock:
+            return self._ctx.get(key)
 
     # ------------------------------------------------------------- reading
 

@@ -21,6 +21,9 @@ Modes (env ``GELLY_COORD_MODE``):
 
 env: COORD, NPROCS, PID_IDX, REPO_ROOT, GELLY_COORD_{STORE,OUT,MODE}
      GELLY_COORD_{EDGES,NV,CHUNK,SLEEP,CADENCE}
+     GELLY_COORD_TRACE — when set, each host installs a SpanTracer for
+     the coordinated fold and exports its ring to ``<prefix>.<pid>.json``
+     (the per-host inputs ``obs.export.stitch_traces`` merges).
 Prints ``COORD_RESUMED <position> <chunks_folded>`` after recovery and
 ``COORD_OK <pid>`` on success.
 """
@@ -127,6 +130,21 @@ def run():
     )
     assert jax.process_count() == NPROCS
 
+    import contextlib
+
+    from gelly_tpu import obs
+
+    trace_prefix = os.environ.get("GELLY_COORD_TRACE")
+    tracer = None
+    stack = contextlib.ExitStack()
+    if trace_prefix:
+        # One ring per host: every span/instant this process records —
+        # including the mirrored ``coordination.barrier_agreed``
+        # instants ``stitch_traces`` aligns clocks on — lands in this
+        # host's own exported file.
+        tracer = obs.SpanTracer(capacity=16384, heartbeat_every_s=None)
+        stack.enter_context(obs.install(tracer))
+
     from gelly_tpu.engine.coordination import (
         CoordinationConfig,
         Coordinator,
@@ -229,6 +247,9 @@ def run():
         os.environ["GELLY_COORD_OUT"] + f".{pid}", local, mp, ms,
         position=runner.position,
     )
+    if tracer is not None:
+        obs.write_chrome_trace(f"{trace_prefix}.{pid}.json", tracer)
+    stack.close()
     print("COORD_OK", pid, flush=True)
 
 

@@ -760,6 +760,82 @@ def test_suppression_all_and_wrong_rule(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# AL rules (best-effort alert plane must stay outside the exactly-once
+# protocol state: ISSUE 20)
+
+AL_SRC = textwrap.dedent('''\
+    from gelly_tpu.ingest import wire
+
+
+    class BadAlertPusher:
+        """Alert delivery that leaks into exactly-once state: every
+        mutation inside the ALERT-packing scope must flag."""
+
+        def __init__(self, sock, q):
+            self._sock = sock
+            self._q = q
+            self._next_seq = 0
+            self._unacked = {}
+
+        def push_alert(self, seq, body):
+            frame = wire.pack_frame(wire.ALERT, seq, body)
+            self._sock.sendall(frame)
+            self._next_seq = seq + 1                     # M-AL001-SEQ
+            self._unacked[seq] = frame                   # M-AL001-BUF
+            self._q.put((seq, body))                     # M-AL001-STAGE
+''')
+
+
+def test_al001_flags_line_anchored(tmp_path):
+    findings = _lint_src(tmp_path, AL_SRC)
+    got = {(f.rule, f.line) for f in findings}
+    assert got == {
+        ("AL001", _line_of(AL_SRC, "M-AL001-SEQ")),
+        ("AL001", _line_of(AL_SRC, "M-AL001-BUF")),
+        ("AL001", _line_of(AL_SRC, "M-AL001-STAGE")),
+    }, "\n".join(f.render() for f in findings)
+
+
+AL_CLEAN_SRC = textwrap.dedent('''\
+    from gelly_tpu.ingest import wire
+
+
+    class GoodAlertPusher:
+        """The ingest/server.py shape: the push closure only packs the
+        ALERT frame and bumps best-effort delivery counters — the data
+        plane's seq/ack/resend state is never touched."""
+
+        def __init__(self, sock, bus):
+            self._sock = sock
+            self._bus = bus
+
+        def push_alert(self, alert_seq, body):
+            frame = wire.pack_frame(wire.ALERT, alert_seq, body)
+            try:
+                self._sock.sendall(frame)
+                self._bus.inc("alerts.pushed")
+            except OSError:
+                self._bus.inc("alerts.dropped")
+''')
+
+
+def test_al001_clean_push_closure(tmp_path):
+    findings = _lint_src(tmp_path, AL_CLEAN_SRC)
+    assert [f for f in findings if f.rule == "AL001"] == [], \
+        "\n".join(f.render() for f in findings)
+
+
+def test_al001_inactive_without_alert_send(tmp_path):
+    # The same mutations in a DATA-sending scope are WP territory, not
+    # AL001's: the rule keys on the ALERT frame type reaching
+    # pack_frame in the scope.
+    src = AL_SRC.replace("wire.ALERT", "wire.DATA")
+    findings = _lint_src(tmp_path, src)
+    assert not any(f.rule == "AL001" for f in findings), \
+        "\n".join(f.render() for f in findings)
+
+
+# --------------------------------------------------------------------- #
 # every seeded violation flips the CLI exit code (ISSUE 11 acceptance)
 
 _RULE_SEEDS = {
@@ -770,6 +846,7 @@ _RULE_SEEDS = {
     "WP001": {"mod.py": WP_SRC},
     "WP002": {"mod.py": WP_SRC},
     "WP003": {"mod.py": WP_SRC},
+    "AL001": {"mod.py": AL_SRC},
     "OB001": {"bus.py": OB_BUS_SRC, "mod.py": OB_MOD_SRC},
     "OB002": {"bus.py": OB_BUS_SRC, "mod.py": OB_MOD_SRC},
     "OB003": {"bus.py": OB_BUS_SRC, "mod.py": OB_MOD_SRC},
@@ -831,7 +908,8 @@ def test_cli_skip_contracts(capsys):
 def test_cli_list_rules_includes_contract_rules(capsys):
     assert analysis_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in ("EO001", "EO004", "WP001", "WP003", "OB001", "OB003"):
+    for rid in ("EO001", "EO004", "WP001", "WP003", "AL001", "OB001",
+                "OB003"):
         assert rid in out
 
 

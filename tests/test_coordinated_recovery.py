@@ -227,3 +227,62 @@ def test_gloo_sigkill_follower_midstream_resumes_bit_identical(tmp_path):
     never changes hands, but the leader must abort its next barrier on
     the dead peer's lease and the restart path is identical."""
     _kill_and_restart(tmp_path, victim=1)
+
+
+@pytest.mark.slow
+def test_gloo_per_host_traces_stitch_into_one_timeline(tmp_path):
+    """ISSUE 20 acceptance: an uninterrupted 2-process gloo run exports
+    one trace ring per host (``GELLY_COORD_TRACE``); ``stitch_traces``
+    merges them into a single valid timeline — one pid per host, clocks
+    aligned on the first shared ``coordination.barrier_agreed`` epoch,
+    and a flow-arrow pair drawn at every shared barrier."""
+    from gelly_tpu.obs.export import stitch_traces, validate_chrome_trace
+
+    store = str(tmp_path / "store")
+    out = str(tmp_path / "run.npz")
+    tprefix = str(tmp_path / "ring")
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in (0, 1):
+        env = _env(
+            COORD=coord, NPROCS=2, PID_IDX=pid,
+            GELLY_COORD_STORE=store, GELLY_COORD_OUT=out,
+            GELLY_COORD_SLEEP=0.0, GELLY_COORD_TRACE=tprefix,
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-I", CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = _drain(procs)
+    for rc, stdout, stderr in outs:
+        assert rc == 0, f"worker failed\n{stdout}\n{stderr}"
+        assert "COORD_OK" in stdout
+
+    rings = [f"{tprefix}.{pid}.json" for pid in (0, 1)]
+    for r in rings:
+        with open(r) as f:
+            validate_chrome_trace(json.load(f))
+
+    merged_path = str(tmp_path / "stitched.json")
+    trace = stitch_traces(rings, out_path=merged_path)
+    other = trace["otherData"]
+    assert other["stitched_hosts"] == 2
+    assert other["barrier_epochs"], "no shared barrier epoch recorded"
+    # One pid per host, identity preserved.
+    assert {m["host"]["process_index"]
+            for m in other["hosts"].values()} == {0, 1}
+    pids = {ev["pid"] for ev in trace["traceEvents"]}
+    assert pids == {1, 2}
+    # The aligned barrier instants coincide per epoch, and every shared
+    # epoch drew its "s"/"f" flow pair across hosts.
+    flows_s = [ev for ev in trace["traceEvents"] if ev["ph"] == "s"]
+    flows_f = [ev for ev in trace["traceEvents"] if ev["ph"] == "f"]
+    assert len(flows_s) == len(flows_f) == len(other["barrier_epochs"])
+    ep0 = other["barrier_epochs"][0]
+    at = [ev["ts"] for ev in trace["traceEvents"]
+          if ev.get("name") == "coordination.barrier_agreed"
+          and (ev.get("args") or {}).get("epoch") == ep0]
+    assert len(at) == 2 and abs(at[0] - at[1]) < 1e-6
+    # Round-trips through disk as valid Chrome-trace JSON.
+    with open(merged_path) as f:
+        validate_chrome_trace(json.load(f))

@@ -649,10 +649,43 @@ def test_heartbeat_carries_tenant_fields():
     assert "tenants_active" in line
     assert "tenants_queue_depth" in line
     assert "starved" in line
+    assert "backlog_age_max_s" in line
+    assert line["slo_breaching"] == 0  # no plane attached, gauge absent
     folds = [s for s in tracer.spans() if s["name"] == "fold"]
     assert folds and all(
         s["args"]["lanes"] >= s["args"]["advanced"] for s in folds
     )
+
+
+def test_attached_slo_plane_ticks_from_scheduler():
+    """An attached SLO plane is evaluated inside the dispatch loop's
+    rate-limited gauge block: per-tenant burn-rate gauges and the
+    ``slo.breaching`` headline gauge exist after a drain, and the
+    heartbeat mirrors the count without a second evaluation thread."""
+    from gelly_tpu.obs import SpanTracer, install
+    from gelly_tpu.obs.slo import SloPlane, tenant_backlog_age_s
+
+    cc = _cc_plan()
+    tracer = SpanTracer(heartbeat_every_s=0.0)
+    with obs_bus.scope() as bus:
+        with install(tracer):
+            eng = MultiTenantEngine(merge_every=1)
+            # Impossible-to-breach threshold: the assertion is about
+            # plumbing (gauges published from the scheduler), not about
+            # forcing a breach (test_slo.py covers breaches).
+            eng.attach_slo_plane(
+                SloPlane([tenant_backlog_age_s(1e9)], bus=bus))
+            eng.add_tier("cc", cc, CHUNK)
+            for i in range(2):
+                eng.admit(i, "cc", chunks=_stream(i))
+            eng.drain()
+        snap = bus.snapshot()["gauges"]
+        assert snap.get("slo.breaching") == 0
+        burn = [k for k in snap if k.endswith(".burn_rate")]
+        assert any(".t0" in k for k in burn) and any(
+            ".t1" in k for k in burn)
+    beats = [i for i in tracer.instants() if i["name"] == "heartbeat"]
+    assert beats and beats[-1]["args"]["slo_breaching"] == 0
 
 
 # --------------------------------------------------------------------- #

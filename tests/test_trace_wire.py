@@ -1,0 +1,443 @@
+"""Wire trace propagation + push alert subscriptions (ISSUE 20).
+
+Trace side: clients stamp DATA/STACKED frames with (trace_id, span id)
+riding the payload dict; the server links wire_recv/staging spans to
+the stamp and the engine links fold/checkpoint through the tracer's
+position→context registry — one trace shows the whole causal chain.
+Retransmitted frames resend the ORIGINAL stamped bytes (same trace),
+and all K payloads of a STACKED frame share one frame-level span.
+
+Alert side: SUBSCRIBE registers a filter; EventBus events matching it
+are pushed as ALERT frames. Delivery is best-effort and entirely
+outside the exactly-once data seq space — asserted here by completing
+a data stream bit-exactly while alerts interleave on the connection.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from gelly_tpu import obs
+from gelly_tpu.engine.aggregation import run_aggregation
+from gelly_tpu.ingest import (
+    IngestClient,
+    IngestServer,
+    edge_payload,
+)
+from gelly_tpu.ingest import wire
+from gelly_tpu.ingest.client import IngestError
+from gelly_tpu.library.connected_components import connected_components
+from gelly_tpu.obs import bus as obs_bus
+from gelly_tpu.obs import slo
+
+pytestmark = pytest.mark.ingest
+
+
+def _drain(server, out):
+    def run():
+        for seq, payload in server.payloads():
+            out.append((seq, payload))
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _one(spans, **match):
+    """The single span whose args carry every ``match`` item."""
+    hits = [s for s in spans
+            if all(s["args"].get(k) == v for k, v in match.items())]
+    assert len(hits) == 1, (match, [s["args"] for s in spans])
+    return hits[0]
+
+
+# --------------------------------------------------------------------- #
+# trace context on the wire
+
+
+def test_data_frame_carries_trace_context():
+    tracer = obs.SpanTracer(capacity=4096, heartbeat_every_s=None)
+    with obs_bus.scope(), obs.install(tracer):
+        with IngestServer(queue_depth=8) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                cli.send(edge_payload([1], [2]))
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+    assert len(got) == 1
+    # The stamp never reaches the consumer.
+    assert wire.TRACE_KEY not in got[0][1]
+    sends = tracer.spans("client_send")
+    recvs = tracer.spans("wire_recv")
+    stages = tracer.spans("staging")
+    assert len(sends) == len(recvs) == len(stages) == 1
+    send, recv, stage = sends[0], recvs[0], stages[0]
+    # One trace id end to end, span ids chained send → recv → staging.
+    assert send["args"]["trace"] == tracer.trace_id
+    assert recv["args"]["trace"] == tracer.trace_id
+    assert stage["args"]["trace"] == tracer.trace_id
+    assert recv["args"]["parent"] == send["args"]["span"]
+    assert stage["args"]["parent"] == recv["args"]["span"]
+    # The staged position is bound for the engine's fold to pick up.
+    assert tracer.ctx(0) == (tracer.trace_id, stage["args"]["span"])
+
+
+def test_stacked_frame_links_all_payloads_to_one_frame_span():
+    K = 4
+    tracer = obs.SpanTracer(capacity=4096, heartbeat_every_s=None)
+    with obs_bus.scope(), obs.install(tracer):
+        with IngestServer(queue_depth=16) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port, stack=K) as cli:
+                for i in range(K):
+                    cli.send(edge_payload([i], [i + 1]))
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+    assert [s for s, _ in got] == list(range(K))
+    # ONE frame-level client span covering the whole stack...
+    send = _one(tracer.spans("client_send"), stack=K)
+    recv = _one(tracer.spans("wire_recv"), stack=K)
+    assert recv["args"]["parent"] == send["args"]["span"]
+    # ...and every payload position staged under it, bound to the one
+    # staging span of the one wire frame.
+    stage = _one(tracer.spans("staging"), stack=K)
+    assert stage["args"]["parent"] == recv["args"]["span"]
+    for pos in range(K):
+        assert tracer.ctx(pos) == (tracer.trace_id,
+                                   stage["args"]["span"])
+
+
+def test_retransmit_reuses_original_trace_context():
+    """REJECT-driven retransmits resend the ORIGINAL stamped frame
+    bytes: no second client_send span, no second trace context — the
+    staging context after the retransmit is the first send's."""
+    tracer = obs.SpanTracer(capacity=4096, heartbeat_every_s=None)
+    with obs_bus.scope() as bus, obs.install(tracer):
+        with IngestServer(queue_depth=8) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            cli = IngestClient("127.0.0.1", srv.port).connect()
+            # Corrupt the first DATA frame on the wire (one payload
+            # byte flipped AFTER packing — the stamped bytes in the
+            # resend buffer stay intact): the server rejects, the
+            # client retransmits the buffered original.
+            orig = cli._raw_send
+            left = [1]
+
+            def corrupting(frame):
+                if left[0] and len(frame) > 100:  # only DATA is this big
+                    left[0] -= 1
+                    frame = frame[:-1] + bytes([frame[-1] ^ 0xFF])
+                orig(frame)
+
+            cli._raw_send = corrupting
+            cli.send(edge_payload([1], [2]))
+            cli.flush(timeout=10)
+            cli.close()
+            assert left[0] == 0  # the corruption really happened
+        t.join(timeout=5)
+        resent = bus.snapshot()["counters"].get("ingest.frames_resent", 0)
+    assert resent >= 1
+    assert len(got) == 1
+    sends = tracer.spans("client_send")
+    assert len(sends) == 1  # retransmit minted NO new span
+    # Every server-side receive of that seq carries the one original
+    # context (duplicate receives are possible; fresh traces are not).
+    recvs = tracer.spans("wire_recv")
+    assert recvs, "no wire_recv spans recorded"
+    for r in recvs:
+        assert r["args"]["trace"] == tracer.trace_id
+        assert r["args"]["parent"] == sends[0]["args"]["span"]
+
+
+def test_unstamped_and_malformed_stamps_degrade_silently():
+    # pop_trace: absent and malformed stamps are both "no context".
+    assert wire.pop_trace({"x": np.arange(2)}) is None
+    bad = {wire.TRACE_KEY: np.arange(3, dtype=np.uint64)}
+    assert wire.pop_trace(bad) is None
+    assert wire.TRACE_KEY not in bad  # still consumed off the payload
+    # A malformed stamp on the wire is not a protocol error: the frame
+    # stages fine, minus the stamp (no tracer installed → the client
+    # passes the caller's dict through, bogus "_trace" key included).
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=8) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                p = edge_payload([1], [2])
+                p[wire.TRACE_KEY] = np.arange(5, dtype=np.uint64)
+                cli.send(p)
+                cli.flush(timeout=10)
+        t.join(timeout=5)
+        assert bus.snapshot()["counters"].get(
+            "ingest.frames_rejected", 0) == 0
+    assert len(got) == 1
+    assert wire.TRACE_KEY not in got[0][1]
+    assert got[0][1]["src"].tolist() == [1]
+
+
+def test_e2e_wire_to_checkpoint_shares_one_trace(tmp_path):
+    """The acceptance chain: client send → wire recv → staging → fold →
+    checkpoint, one trace_id, span ids linked stage to stage — and the
+    exported trace validates."""
+    N_V = 64
+    agg = connected_components(N_V)
+    rng = np.random.default_rng(7)
+    edges = rng.integers(0, N_V, (48, 2))
+    tracer = obs.SpanTracer(capacity=1 << 14, heartbeat_every_s=None)
+    with obs_bus.scope() as bus, obs.install(tracer):
+        with IngestServer(queue_depth=16, stop_on_bye=True) as srv:
+            def feed():
+                with IngestClient("127.0.0.1", srv.port) as cli:
+                    for i in range(0, 48, 16):
+                        cli.send(edge_payload(edges[i:i + 16, 0],
+                                              edges[i:i + 16, 1]))
+                    cli.flush(timeout=30)
+            ft = threading.Thread(target=feed, daemon=True)
+            ft.start()
+            run_aggregation(
+                agg, srv.chunks(16, N_V), merge_every=1,
+                checkpoint_path=str(tmp_path / "ck.npz"),
+                checkpoint_every=1, ingest_workers=0, prefetch_depth=0,
+                h2d_depth=0,
+            ).result()
+            ft.join(timeout=30)
+        trace = obs.write_chrome_trace(
+            str(tmp_path / "trace_e2e_wire.json"), tracer, bus=bus)
+    assert trace["otherData"]["trace_id"] == tracer.trace_id
+    # Follow chunk 0's causal chain by explicit span-id links.
+    send = _one(tracer.spans("client_send"), seq=0)
+    recv = _one(tracer.spans("wire_recv"), seq=0)
+    stage = _one(tracer.spans("staging"), seq=0)
+    assert recv["args"]["parent"] == send["args"]["span"]
+    assert stage["args"]["parent"] == recv["args"]["span"]
+    # The fold of the first unit links to chunk 0's staging span...
+    folds = [s for s in tracer.spans("fold")
+             if s["args"].get("parent") == stage["args"]["span"]]
+    assert len(folds) == 1
+    fold = folds[0]
+    assert fold["args"]["trace"] == tracer.trace_id
+    # ...and a checkpoint links to a fold span, closing the chain.
+    fold_ids = {s["args"]["span"] for s in tracer.spans("fold")}
+    ckpts = tracer.spans("checkpoint")
+    assert ckpts
+    linked = [c for c in ckpts if c["args"].get("parent") in fold_ids]
+    assert linked, [c["args"] for c in ckpts]
+    for c in linked:
+        assert c["args"]["trace"] == tracer.trace_id
+
+
+# --------------------------------------------------------------------- #
+# push alert subscriptions
+
+
+def test_subscribe_pushes_matching_alerts_only():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=8) as srv:
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                seen = []
+                sub_id = cli.subscribe(events=("slo.",),
+                                       on_alert=seen.append)
+                assert sub_id >= 1
+                assert bus.gauges.get("alerts.subscribers") == 1
+                bus.emit("slo.breach", slo="fold_p99_ms", tenant=None,
+                         value=50.0, threshold=10.0, burn_rate=1.0)
+                bus.emit("alerts.degree_spike", degree=99.0)  # filtered
+                deadline = time.monotonic() + 5
+                while not seen and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert len(seen) == 1
+                alert = seen[0]
+                assert alert["event"] == "slo.breach"
+                assert alert["sub_id"] == sub_id
+                assert alert["fields"]["slo"] == "fold_p99_ms"
+                assert alert["fields"]["value"] == 50.0
+                assert cli.alerts[-1] == alert
+        counters = bus.snapshot()["counters"]
+        assert counters["alerts.subscriptions"] == 1
+        assert counters["alerts.pushed"] == 1
+        assert counters["ingest.alerts_received"] == 1
+        # Teardown returned the subscriber gauge to zero.
+        assert bus.gauges.get("alerts.subscribers") == 0
+
+
+def test_subscribe_tenant_and_slo_filters():
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=8) as srv:
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                seen = []
+                cli.subscribe(events=("slo.",), tenant=3,
+                              slo="backlog_age_s", on_alert=seen.append)
+                bus.emit("slo.breach", slo="backlog_age_s", tenant=7,
+                         value=9.0)   # wrong tenant
+                bus.emit("slo.breach", slo="fold_p99_ms", tenant=3,
+                         value=9.0)   # wrong slo
+                bus.emit("slo.breach", slo="backlog_age_s", tenant=3,
+                         value=9.0)   # match
+                deadline = time.monotonic() + 5
+                while not seen and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                time.sleep(0.1)  # would-be stragglers
+                assert [a["fields"]["tenant"] for a in seen] == [3]
+                with pytest.raises(IngestError, match="malformed"):
+                    cli._sub_evt.clear()
+                    cli._sock.sendall(wire.pack_frame(
+                        wire.SUBSCRIBE, 99, b"\xff\xfe"))
+                    if not cli._sub_evt.wait(5):
+                        raise AssertionError("no SUBSCRIBE reply")
+                    payload = wire.unpack_json(cli._sub_payload)
+                    if not payload.get("ok"):
+                        raise IngestError(payload.get("error", "?"))
+
+
+def test_degree_spike_stream_delivers_push_alert():
+    """The acceptance scenario: a seeded degree-spike stream — uniform
+    chunks, then one hub chunk — drives the summary-delta watch on the
+    server side, and the subscribed loopback client receives the
+    ``alerts.degree_spike`` ALERT frame."""
+    rng = np.random.default_rng(11)
+    N_V = 256
+    with obs_bus.scope() as bus:
+        watch = slo.SummaryDeltaWatch(bus=bus, spike_factor=4.0,
+                                      min_degree=8)
+        with IngestServer(queue_depth=32) as srv:
+            def consume():
+                for _seq, payload in srv.payloads():
+                    deg = np.bincount(payload["dst"], minlength=N_V)
+                    watch.observe(max_degree=int(deg.max()))
+            ct = threading.Thread(target=consume, daemon=True)
+            ct.start()
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                spikes = []
+                cli.subscribe(events=("alerts.degree_spike",),
+                              on_alert=spikes.append)
+                # Steady uniform chunks build the EMA baseline...
+                for _ in range(6):
+                    e = rng.integers(0, N_V, (64, 2))
+                    cli.send(edge_payload(e[:, 0], e[:, 1]))
+                cli.flush(timeout=10)
+                # ...then the hub chunk: every edge into vertex 0.
+                src = rng.integers(0, N_V, 64)
+                cli.send(edge_payload(src, np.zeros(64, dtype=np.int64)))
+                cli.flush(timeout=10)
+                deadline = time.monotonic() + 5
+                while not spikes and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert spikes, "degree-spike ALERT never arrived"
+                assert spikes[0]["event"] == "alerts.degree_spike"
+                assert spikes[0]["fields"]["degree"] >= 32.0
+        assert bus.snapshot()["counters"]["alerts.pushed"] >= 1
+
+
+def test_blown_backlog_slo_pushes_breach_alert_end_to_end():
+    """SLO plane + alert plane, end to end: a deliberately-blown
+    ``backlog_age_max_s`` SLO (ingress stamped, never retired) raises
+    its burn-rate gauge AND the breach lands at the subscribed client
+    as a pushed ALERT frame."""
+    with obs_bus.scope() as bus:
+        plane = slo.SloPlane([slo.backlog_age_max_s(0.005)], bus=bus)
+        with IngestServer(queue_depth=8) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                breaches = []
+                cli.subscribe(events=("slo.breach",),
+                              on_alert=breaches.append)
+                cli.send(edge_payload([1], [2]))
+                cli.flush(timeout=10)
+                bus.watermarks.stamp("stream", 0)
+                time.sleep(0.02)  # age past the 5 ms budget
+                assert plane.tick() == 1
+                assert bus.gauges[
+                    "slo.backlog_age_max_s.burn_rate"] == 1.0
+                deadline = time.monotonic() + 5
+                while not breaches and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert breaches, "breach ALERT never arrived"
+                fields = breaches[0]["fields"]
+                assert fields["slo"] == "backlog_age_max_s"
+                assert fields["value"] >= 0.005
+        t.join(timeout=5)
+
+
+def test_alert_plane_stays_outside_data_seq_space():
+    """Alerts interleaving with DATA on one connection must not
+    perturb the exactly-once stream: every chunk lands once, acks
+    complete, the resend buffer drains — while ALERT frames flow."""
+    with obs_bus.scope() as bus:
+        with IngestServer(queue_depth=32) as srv:
+            got: list = []
+            t = _drain(srv, got)
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                seen = []
+                cli.subscribe(events=("alerts.",), on_alert=seen.append)
+                for i in range(20):
+                    cli.send(edge_payload([i], [i + 1]))
+                    if i % 5 == 0:
+                        bus.emit("alerts.degree_spike", degree=float(i))
+                cli.flush(timeout=10)
+                deadline = time.monotonic() + 5
+                while len(seen) < 4 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert cli.acked == 20
+                assert cli.unacked_count == 0
+                assert len(seen) == 4
+        t.join(timeout=5)
+        assert [s for s, _ in got] == list(range(20))
+        counters = bus.snapshot()["counters"]
+        assert counters["ingest.chunks_enqueued"] == 20
+        assert counters["alerts.pushed"] == 4
+        assert counters.get("alerts.dropped", 0) == 0
+
+
+def test_router_attached_heartbeat_carries_tenant_fields():
+    """Satellite 6, router-attached: a TenantRouter feeding the tenant
+    scheduler from a live wire server beats with the full tenant field
+    set — tenants_active, tenants_queue_depth, backlog_age_max_s and
+    slo_breaching — mirrored onto the installed tracer."""
+    from gelly_tpu.engine.tenants import MultiTenantEngine
+    from gelly_tpu.ingest import TenantRouter
+    from gelly_tpu.library.connected_components import cc_tenant_tier
+
+    n_v = 64
+    tracer = obs.SpanTracer(capacity=4096, heartbeat_every_s=0.0)
+    with obs_bus.scope(), obs.install(tracer):
+        agg, cap = cc_tenant_tier(n_v, chunk_capacity=16)
+        eng = MultiTenantEngine(merge_every=1).start()
+        router = TenantRouter(eng, "small", vertex_capacity=n_v)
+        eng.add_tier("small", agg, cap)
+        with IngestServer(queue_depth=16) as srv:
+            router.attach(srv)
+            with IngestClient("127.0.0.1", srv.port) as cli:
+                rng = np.random.default_rng(7)
+                for tid in (3, 4):
+                    for _ in range(2):
+                        p = edge_payload(rng.integers(0, n_v, 8),
+                                         rng.integers(0, n_v, 8))
+                        p["tenant"] = np.array([tid], np.int64)
+                        cli.send(p)
+                cli.flush(timeout=30)
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        if (eng.position(3) >= 2
+                                and eng.position(4) >= 2):
+                            break
+                    except KeyError:
+                        pass
+                    time.sleep(0.01)
+        eng.stop()
+    beats = tracer.instants("heartbeat")
+    assert beats, "router-attached scheduler never beat"
+    line = beats[-1]["args"]
+    for field in ("tenants_active", "tenants_queue_depth",
+                  "backlog_age_max_s", "slo_breaching"):
+        assert field in line, (field, line)
+    assert line["slo_breaching"] == 0
